@@ -1,0 +1,27 @@
+"""kubeflow_tpu — a TPU-native ML orchestration platform + first-party JAX data plane.
+
+Capability-equivalent rebuild of the Kubeflow distribution (reference:
+``fast-ml/kubeflow``; see SURVEY.md — the reference mount was empty at survey
+time, so parity targets come from SURVEY.md §2 and BASELINE.json) designed
+TPU-first:
+
+- ``parallel``   — device meshes, logical-axis sharding rules, ring attention,
+                   collectives (DP/FSDP/TP/SP/CP/EP over ICI+DCN).
+- ``ops``        — attention (XLA + Pallas flash), RoPE, norms, losses.
+- ``models``     — Llama-3 family (flagship), ResNet, MNIST CNN.
+- ``training``   — pjit train loop, mixed precision, remat, Orbax checkpointing.
+- ``api``        — JAXJob/TFJob CRD-equivalent typed specs (RunPolicy,
+                   ReplicaSpec, conditions) a la training-operator.
+- ``controller`` — reconciling job controller + gang scheduling + local
+                   multi-process backend (jax.distributed rendezvous).
+- ``client``     — TrainingClient-style SDK.
+- ``tune``       — Katib-equivalent HPO: experiments, suggestion algorithms,
+                   trial controller, early stopping.
+- ``pipelines``  — KFP-equivalent: Python DSL -> IR -> DAG executor + caching.
+- ``metadata``   — MLMD-equivalent lineage store.
+- ``serving``    — KServe-equivalent: InferenceService spec, model server
+                   (V1/V2 inference protocol), JAX predictor with AOT compile
+                   cache, dynamic batching.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from kubeflow_tpu.models import llama, mnist, resnet

@@ -1,0 +1,317 @@
+"""Llama-3 model family, TPU-first.
+
+Design (deliberately not a torch translation — SURVEY.md §7 design stance):
+
+- Pure-functional: params are a pytree of arrays; `forward` is a jittable
+  function. No module framework in the hot path.
+- **Scan over layers**: all transformer blocks are stacked along a leading
+  `layers` axis and executed with `jax.lax.scan`, so XLA compiles ONE block
+  regardless of depth (compile time O(1) in n_layers) and remat policy applies
+  uniformly.
+- **Logical axes everywhere**: every param/activation carries logical axis
+  names resolved against a mesh by `parallel.sharding` rules — the same model
+  runs DP/FSDP/TP/SP by swapping the rule table.
+- bf16 compute, f32 params (casting at the boundary), f32 softmax/norms.
+
+Reference parity: the reference (Kubeflow) ships no model code — models live
+in user containers. This module is the first-party data plane SURVEY.md §7
+requires, sized for the BASELINE.json configs (Llama-3-8B serving, 70B FSDP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import attention, decode_attention
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
+from kubeflow_tpu.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    rope_scaling: str | None = "llama3"
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"            # "xla" | "flash" | "pallas"
+    remat: str = "full"               # "none" | "full" | "dots"
+    z_loss: float = 1e-4
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approx model FLOPs per token (fwd+bwd = 3x fwd matmul FLOPs)."""
+        d, m, v = self.dim, self.mlp_dim, self.vocab_size
+        attn_proj = 2 * d * (self.n_heads + 2 * self.n_kv_heads) * self.head_dim
+        attn_out = 2 * self.n_heads * self.head_dim * d
+        mlp = 2 * 3 * d * m
+        per_layer = attn_proj + attn_out + mlp
+        return 3 * (self.n_layers * per_layer + 2 * d * v)
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama3_70b(**kw) -> LlamaConfig:
+    return LlamaConfig(
+        dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, mlp_dim=28672, **kw
+    )
+
+
+def llama_1b(**kw) -> LlamaConfig:
+    """Single-v5e-chip benchmark config (16G HBM)."""
+    return LlamaConfig(
+        vocab_size=32768, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        mlp_dim=5632, max_seq=2048, tie_embeddings=True, **kw
+    )
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """CI config: runs on CPU in seconds."""
+    return LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq=128, rope_scaling=None, tie_embeddings=True, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32):
+    """Initialize parameters (stacked along a leading `layers` axis)."""
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+    d, h, kv, hd, m, L = (
+        cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.mlp_dim,
+        cfg.n_layers,
+    )
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params = {
+        "embed": dense(k_embed, (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "mlp_norm": jnp.ones((L, d), dtype),
+            "wq": dense(ks[0], (L, d, h, hd), d),
+            "wk": dense(ks[1], (L, d, kv, hd), d),
+            "wv": dense(ks[2], (L, d, kv, hd), d),
+            "wo": dense(ks[3], (L, h, hd, d), h * hd),
+            "w_gate": dense(ks[4], (L, d, m), d),
+            "w_up": dense(ks[5], (L, d, m), d),
+            "w_down": dense(ks[6], (L, m, d), m),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k_head, (d, cfg.vocab_size), d)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig):
+    """Logical axis names per param, mirroring init_params' structure."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _block(x, lp, inv_freq, positions, cfg: LlamaConfig):
+    """One transformer block. x: [B,S,D] in compute dtype."""
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+    q = constrain(q, ("batch", "seq", "act_heads", None))
+    k = constrain(k, ("batch", "seq", None, None))
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+    x = x + constrain(o, ("batch", "seq", "act_embed"))
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
+    ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "act_mlp"))
+    down = jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
+    return x + constrain(down, ("batch", "seq", "act_embed"))
+
+
+def _remat_wrap(fn, cfg: LlamaConfig):
+    if cfg.remat == "none":
+        return fn
+    if cfg.remat == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn)
+
+
+def forward(params, tokens, cfg: LlamaConfig, positions=None):
+    """Full-sequence forward. tokens: [B,S] int32 -> logits [B,S,V] (f32)."""
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])[None, :]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "act_embed"))
+
+    block = _remat_wrap(
+        lambda x, lp: (_block(x, lp, inv_freq, positions, cfg), None), cfg
+    )
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+    logits = constrain(logits, ("batch", "seq", None))
+    return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached decoding (serving path)
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "len": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def prefill(params, tokens, cfg: LlamaConfig, cache):
+    """Run the prompt through the model, filling the cache.
+
+    tokens: [B,S]. Returns (logits_last [B,V], cache). Assumes left-aligned
+    prompts of equal length S (the batcher pads; per-seq lengths tracked in
+    cache["len"]).
+    """
+    b, s = tokens.shape
+    positions = jnp.arange(s)[None, :]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def block(x, xs):
+        lp, k_cache_l, v_cache_l = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        o = attention(q, k, v, causal=True, impl="xla")
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        x = x + o
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.silu(
+            jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
+        ) * jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
+        x = x + jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
+        new_k = jax.lax.dynamic_update_slice(
+            k_cache_l, k.astype(k_cache_l.dtype), (0, 0, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            v_cache_l, v.astype(v_cache_l.dtype), (0, 0, 0, 0)
+        )
+        return x, (new_k, new_v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], head.astype(cfg.dtype))
+    cache = {"k": new_k, "v": new_v,
+             "len": jnp.full((b,), s, jnp.int32)}
+    return logits.astype(jnp.float32), cache
+
+
+def decode_step(params, token, cfg: LlamaConfig, cache):
+    """One decode step. token: [B] int32 -> (logits [B,V], cache)."""
+    b = token.shape[0]
+    pos = cache["len"]  # [B]
+    positions = pos[:, None]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+    x = params["embed"].astype(cfg.dtype)[token[:, None]]
+
+    def block(x, xs):
+        lp, k_cache_l, v_cache_l = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        # scatter the new KV row at each sequence's current length
+        idx = pos[:, None, None, None]
+        onehot = (jnp.arange(k_cache_l.shape[1])[None, :, None, None] == idx)
+        new_k = jnp.where(onehot, k.astype(k_cache_l.dtype), k_cache_l)
+        new_v = jnp.where(onehot, v.astype(v_cache_l.dtype), v_cache_l)
+        o = decode_attention(q, new_k, new_v, pos + 1)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        x = x + o
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.silu(
+            jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
+        ) * jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
+        x = x + jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
+        return x, (new_k, new_v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), {
+        "k": new_k, "v": new_v, "len": cache["len"] + 1
+    }

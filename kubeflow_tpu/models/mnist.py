@@ -1,0 +1,50 @@
+"""MNIST CNN — the CPU-only baseline config (BASELINE.json:7, 'TFJob
+single-worker MNIST CNN'). Functional JAX, NHWC (TPU-native layout)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_params(rng: jax.Array, dtype=jnp.float32):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def conv(key, shape):  # HWIO
+        fan_in = shape[0] * shape[1] * shape[2]
+        return jax.random.normal(key, shape, dtype) * (2.0 / fan_in) ** 0.5
+
+    def dense(key, shape):
+        return jax.random.normal(key, shape, dtype) * (2.0 / shape[0]) ** 0.5
+
+    return {
+        "conv1": conv(k1, (3, 3, 1, 32)),
+        "conv2": conv(k2, (3, 3, 32, 64)),
+        "fc1": dense(k3, (7 * 7 * 64, 128)),
+        "b1": jnp.zeros((128,), dtype),
+        "fc2": dense(k4, (128, 10)),
+        "b2": jnp.zeros((10,), dtype),
+    }
+
+
+def forward(params, images):
+    """images: [B, 28, 28, 1] -> logits [B, 10]."""
+    x = jax.lax.conv_general_dilated(
+        images, params["conv1"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"] + params["b1"])
+    return x @ params["fc2"] + params["b2"]

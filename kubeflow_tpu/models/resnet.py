@@ -1,0 +1,162 @@
+"""ResNet-v1.5 family for the JAXJob data-parallel milestone
+(BASELINE.json:8: 'ResNet-50 data-parallel on a v4-8 pod slice').
+
+Functional JAX, NHWC, bf16 compute / f32 params+stats. BatchNorm running
+stats are explicit state threaded through `forward` (functional — no mutable
+modules); in data-parallel training the batch statistics are computed over the
+per-device batch and the running stats EMA-synced by the gradient all-reduce's
+sibling psum emitted from sharding (stats are replicated params-like state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: object = jnp.bfloat16
+
+
+def resnet50(**kw) -> ResNetConfig:
+    return ResNetConfig(**kw)
+
+
+def resnet18(**kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2), **kw)
+
+
+def resnet_tiny(**kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=10, **kw)
+
+
+def _conv_init(key, shape):  # HWIO, He init
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def _bn_init(c):
+    return {
+        "scale": jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+    }
+
+
+def _bn_stats(c):
+    return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig):
+    """Returns (params, batch_stats)."""
+    keys = iter(jax.random.split(rng, 1024))
+    params: dict = {}
+    stats: dict = {}
+
+    params["stem"] = {"conv": _conv_init(next(keys), (7, 7, 3, cfg.width)),
+                      "bn": _bn_init(cfg.width)}
+    stats["stem"] = _bn_stats(cfg.width)
+
+    in_c = cfg.width
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        out_c = cfg.width * (2 ** si) * 4
+        mid_c = cfg.width * (2 ** si)
+        stage_p, stage_s = [], []
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk_p = {
+                "conv1": _conv_init(next(keys), (1, 1, in_c, mid_c)),
+                "bn1": _bn_init(mid_c),
+                "conv2": _conv_init(next(keys), (3, 3, mid_c, mid_c)),
+                "bn2": _bn_init(mid_c),
+                "conv3": _conv_init(next(keys), (1, 1, mid_c, out_c)),
+                "bn3": _bn_init(out_c),
+            }
+            blk_s = {"bn1": _bn_stats(mid_c), "bn2": _bn_stats(mid_c),
+                     "bn3": _bn_stats(out_c)}
+            if in_c != out_c or stride != 1:
+                blk_p["proj"] = _conv_init(next(keys), (1, 1, in_c, out_c))
+                blk_p["proj_bn"] = _bn_init(out_c)
+                blk_s["proj_bn"] = _bn_stats(out_c)
+            stage_p.append(blk_p)
+            stage_s.append(blk_s)
+            in_c = out_c
+        params[f"stage{si}"] = stage_p
+        stats[f"stage{si}"] = stage_s
+
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (in_c, cfg.num_classes), jnp.float32)
+        * (1.0 / in_c) ** 0.5,
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params, stats
+
+
+def _conv(x, w, stride, dtype):
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), w.astype(dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+    """Returns (y, new_stats)."""
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = xf.mean(axis=(0, 1, 2))
+        var = xf.var(axis=(0, 1, 2))
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    y = (xf - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def forward(params, stats, images, cfg: ResNetConfig, train: bool = True):
+    """images: [B,H,W,3] -> (logits [B,classes] f32, new_stats)."""
+    dt = cfg.dtype
+    new_stats: dict = {}
+    x = _conv(images, params["stem"]["conv"], 2, dt)
+    x, new_stats["stem"] = _bn(x, params["stem"]["bn"], stats["stem"], train)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+
+    for si in range(len(cfg.stage_sizes)):
+        stage_stats = []
+        for bi, blk in enumerate(params[f"stage{si}"]):
+            s = stats[f"stage{si}"][bi]
+            ns: dict = {}
+            stride = 2 if (si > 0 and bi == 0) else 1
+            residual = x
+            y = _conv(x, blk["conv1"], 1, dt)
+            y, ns["bn1"] = _bn(y, blk["bn1"], s["bn1"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv2"], stride, dt)
+            y, ns["bn2"] = _bn(y, blk["bn2"], s["bn2"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv3"], 1, dt)
+            y, ns["bn3"] = _bn(y, blk["bn3"], s["bn3"], train)
+            if "proj" in blk:
+                residual = _conv(x, blk["proj"], stride, dt)
+                residual, ns["proj_bn"] = _bn(
+                    residual, blk["proj_bn"], s["proj_bn"], train
+                )
+            x = jax.nn.relu(y + residual)
+            stage_stats.append(ns)
+        new_stats[f"stage{si}"] = stage_stats
+
+    x = x.astype(jnp.float32).mean(axis=(1, 2))
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_stats

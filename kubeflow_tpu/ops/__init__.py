@@ -1,0 +1,4 @@
+from kubeflow_tpu.ops.attention import attention, decode_attention
+from kubeflow_tpu.ops.losses import accuracy, softmax_cross_entropy
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies

@@ -1,0 +1,121 @@
+"""Causal grouped-query attention for TPU.
+
+Two execution paths, selected by `impl`:
+
+- "xla": plain einsum attention. XLA fuses softmax chains well on TPU and this
+  is the correct baseline + CPU-test path.
+- "flash": Pallas TPU flash-attention kernel (blockwise, O(S) memory). Uses
+  the stock `jax.experimental.pallas.ops.tpu.flash_attention` kernel; a
+  first-party splash-style kernel lives in ops/pallas_attention.py and can be
+  selected with "pallas".
+
+All paths take q:[B,S,H,D] k/v:[B,S,KV,D] and return [B,S,H,D]. GQA is
+handled by repeating KV heads logically (einsum grouping), never materialized.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, *, causal: bool, q_offset=0, bias=None):
+    b, sq, h, d = q.shape
+    _, skv, kvh, _ = k.shape
+    groups = h // kvh
+    qf = q.astype(jnp.float32).reshape(b, sq, kvh, groups, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qf * scale, kf)
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        q_pos = jnp.arange(sq) + q_offset
+        kv_pos = jnp.arange(skv)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, vf)
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "impl", "block_q", "block_kv")
+)
+def attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    impl: str = "xla",
+    q_offset: int | jax.Array = 0,
+    block_q: int = 512,
+    block_kv: int = 512,
+):
+    """Multi-head / grouped-query attention.
+
+    q: [B, Sq, H, D]; k, v: [B, Skv, KV_H, D] with H % KV_H == 0.
+    `q_offset` shifts query positions for causal masking during decode.
+    """
+    if impl == "flash":
+        return _flash_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+    if impl == "pallas":
+        from kubeflow_tpu.ops.pallas_attention import flash_attention as own_flash
+
+        return own_flash(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+    return _xla_attention(q, k, v, causal=causal, q_offset=q_offset)
+
+
+def _flash_attention(q, k, v, *, causal, block_q, block_kv):
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    if h != kvh:
+        # stock kernel wants matching head counts; expand KV (still O(S) mem)
+        k = jnp.repeat(k, h // kvh, axis=2)
+        v = jnp.repeat(v, h // kvh, axis=2)
+    # kernel layout is [B, H, S, D]
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    sizes = fa.BlockSizes(
+        block_q=min(block_q, s),
+        block_k_major=min(block_kv, s),
+        block_k=min(block_kv, s),
+        block_b=1,
+        block_q_major_dkv=min(block_q, s),
+        block_k_major_dkv=min(block_kv, s),
+        block_k_dkv=min(block_kv, s),
+        block_q_dkv=min(block_q, s),
+        block_k_major_dq=min(block_kv, s),
+        block_k_dq=min(block_kv, s),
+        block_q_dq=min(block_q, s),
+    )
+    out = fa.flash_attention(
+        qt, kt, vt, causal=causal,
+        sm_scale=1.0 / (d ** 0.5),
+        block_sizes=sizes,
+    )
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, cache_len):
+    """Single-step decode attention against a KV cache.
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, S_max, KV, D]; cache_len: [B] int32
+    (number of valid cache entries per sequence, including this step).
+    """
+    b, _, h, d = q.shape
+    kvh = k_cache.shape[2]
+    groups = h // kvh
+    qf = q.astype(jnp.float32).reshape(b, kvh, groups, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bkgd,bskd->bkgs", qf * scale, k_cache.astype(jnp.float32))
+    mask = jnp.arange(k_cache.shape[1])[None, :] < cache_len[:, None]
+    logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(b, 1, h, d).astype(q.dtype)

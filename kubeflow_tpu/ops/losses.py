@@ -1,0 +1,40 @@
+"""Loss functions. Cross-entropy in f32 with optional z-loss, masking, and
+no [B,S,V] float32 materialization beyond what XLA needs (logsumexp fusion)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, mask=None, z_loss: float = 0.0):
+    """Token-level CE. logits: [..., V] (any dtype), labels: [...] int32.
+
+    Returns (mean_loss, aux) where aux has 'total_weight' for correct
+    cross-data-parallel averaging and 'z_loss' if enabled.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    loss = logz - label_logit
+    if z_loss:
+        loss = loss + z_loss * jnp.square(logz)
+    if mask is None:
+        mask = jnp.ones_like(loss)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(mask.sum(), 1.0)
+    return (loss * mask).sum() / total, {
+        "total_weight": total,
+        "sum_loss": (loss * mask).sum(),
+    }
+
+
+def accuracy(logits, labels, mask=None):
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    if mask is None:
+        return correct.mean()
+    mask = mask.astype(jnp.float32)
+    return (correct * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,19 @@
+"""Normalization ops. RMSNorm is the Llama-family workhorse.
+
+Computed in float32 regardless of input dtype (bf16-safe), cast back on exit —
+XLA fuses the whole thing into neighboring ops on TPU so there is no reason
+for a handwritten kernel here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(orig_dtype)

@@ -1,0 +1,52 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 500000.0,
+    scaling: str | None = "llama3",
+    scale_factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_seq: int = 8192,
+) -> np.ndarray:
+    """Inverse frequencies [head_dim//2], optionally Llama-3-scaled for long context."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling == "llama3":
+        low_wavelen = original_max_seq / low_freq_factor
+        high_wavelen = original_max_seq / high_freq_factor
+        wavelen = 2 * np.pi / inv
+        # three bands: keep high-freq, scale low-freq, smooth in between
+        smooth = (original_max_seq / wavelen - low_freq_factor) / (
+            high_freq_factor - low_freq_factor
+        )
+        scaled = np.where(
+            wavelen > low_wavelen,
+            inv / scale_factor,
+            np.where(
+                wavelen < high_wavelen,
+                inv,
+                (1 - smooth) * inv / scale_factor + smooth * inv,
+            ),
+        )
+        inv = scaled
+    return inv.astype(np.float32)
+
+
+def apply_rope(x, positions, inv_freq):
+    """Apply rotary embedding.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq] int32;
+    inv_freq: [head_dim//2].
+    """
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

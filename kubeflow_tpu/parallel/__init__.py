@@ -1,0 +1,15 @@
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    build_mesh,
+    mesh_from_topology_env,
+    single_device_mesh,
+)
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    constrain,
+    named_sharding,
+    pspec,
+    tree_pspecs,
+    tree_shardings,
+)

@@ -1,0 +1,146 @@
+"""Device mesh construction for TPU slices and multi-slice (ICI x DCN) topologies.
+
+Replaces the reference's NCCL/MPI process-group machinery (SURVEY.md §2.8:
+training-operator env rendezvous + in-container NCCL) with the JAX/XLA model:
+a single `jax.sharding.Mesh` whose axes carry all parallelism. Axis order puts
+slow/DCN-friendly axes first and fast/ICI axes last, so XLA lays collectives
+for tensor/context parallelism onto the fastest interconnect dimension.
+
+Canonical axis names (outer -> inner):
+
+- ``data``     — pure data parallelism (gradient all-reduce; DCN-tolerant).
+- ``fsdp``     — data parallelism with parameter/optimizer sharding (ZeRO-3).
+- ``expert``   — MoE expert parallelism (all-to-all dispatch).
+- ``context``  — sequence/context parallelism (ring attention KV rotation).
+- ``tensor``   — tensor (Megatron-style) parallelism; innermost = fastest ICI.
+
+``pipeline`` is handled separately by ``parallel.pipeline`` (stage meshes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Outer-to-inner canonical order; DCN-friendly axes first, ICI-hungry last.
+AXIS_ORDER = ("data", "fsdp", "expert", "context", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape.
+
+    Sizes of -1 mean "absorb all remaining devices" (at most one axis may be -1).
+    ``dcn_data`` / ``dcn_fsdp`` describe the multi-slice outer mesh (number of
+    slices devoted to data/fsdp replication across DCN); 1 = single slice.
+    """
+
+    data: int = 1
+    fsdp: int = -1
+    expert: int = 1
+    context: int = 1
+    tensor: int = 1
+    dcn_data: int = 1
+    dcn_fsdp: int = 1
+
+    def ici_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "expert": self.expert,
+            "context": self.context,
+            "tensor": self.tensor,
+        }
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        """Resolve any -1 axis against the device count (per slice)."""
+        n_slices = self.dcn_data * self.dcn_fsdp
+        if n_devices % n_slices != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by {n_slices} slices"
+            )
+        per_slice = n_devices // n_slices
+        sizes = self.ici_sizes()
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if per_slice % fixed != 0:
+                raise ValueError(
+                    f"cannot infer {wild[0]}: {per_slice} devices/slice not "
+                    f"divisible by fixed product {fixed}"
+                )
+            sizes[wild[0]] = per_slice // fixed
+        elif fixed != per_slice:
+            raise ValueError(
+                f"mesh product {fixed} != devices per slice {per_slice}"
+            )
+        return dataclasses.replace(self, **sizes)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a `jax.sharding.Mesh` from a MeshConfig.
+
+    Single-slice: uses `mesh_utils.create_device_mesh` for ICI-aware placement.
+    Multi-slice (dcn_* > 1): uses `create_hybrid_device_mesh` so the outer
+    data/fsdp axes span DCN and inner axes stay within a slice. The DCN and ICI
+    contributions to `data`/`fsdp` are flattened into a single named axis each,
+    so model code only ever sees the canonical five axes.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = config.resolved(len(devices))
+    ici = [cfg.ici_sizes()[a] for a in AXIS_ORDER]
+
+    if cfg.dcn_data == 1 and cfg.dcn_fsdp == 1:
+        dev_array = mesh_utils.create_device_mesh(ici, devices=devices)
+        return Mesh(dev_array, AXIS_ORDER)
+
+    dcn = [cfg.dcn_data, cfg.dcn_fsdp, 1, 1, 1]
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        ici, dcn_mesh_shape=dcn, devices=devices
+    )
+    # hybrid mesh returns shape [dcn_data*data', dcn_fsdp*fsdp', ...]; axes are
+    # already merged per dimension by create_hybrid_device_mesh.
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def mesh_from_topology_env(env: dict[str, str], devices=None) -> Mesh:
+    """Build a mesh from operator-injected topology env (rendezvous contract).
+
+    The JAXJob controller stamps ``KFT_MESH=data=2,fsdp=4,tensor=2`` and
+    optionally ``KFT_DCN=data=2`` on every worker pod (the TPU-native
+    equivalent of the reference's TF_CONFIG / MASTER_ADDR env injection).
+    """
+    sizes: dict[str, int] = {}
+    for part in env.get("KFT_MESH", "").split(","):
+        if part:
+            k, v = part.split("=")
+            if k not in AXIS_ORDER:
+                raise ValueError(f"unknown mesh axis {k!r}")
+            sizes[k] = int(v)
+    dcn: dict[str, int] = {}
+    for part in env.get("KFT_DCN", "").split(","):
+        if part:
+            k, v = part.split("=")
+            dcn["dcn_" + k] = int(v)
+    cfg = MeshConfig(**sizes, **dcn) if sizes or dcn else MeshConfig()
+    return build_mesh(cfg, devices=devices)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """1-device mesh with all canonical axes (size 1) — lets the same sharded
+    train step run unmodified on one chip."""
+    device = device or jax.devices()[0]
+    arr = np.array([device]).reshape((1,) * len(AXIS_ORDER))
+    return Mesh(arr, AXIS_ORDER)

@@ -1,0 +1,113 @@
+"""Logical-axis sharding rules: the single place parallelism layout is decided.
+
+Model code annotates arrays with *logical* axis names ("batch", "embed",
+"heads", ...). A rule table maps logical names to mesh axes ("data", "fsdp",
+"tensor", ...). Changing the parallelism strategy = changing the rule table;
+model code never mentions mesh axes. This is the TPU-native replacement for
+the reference's per-framework env plumbing (SURVEY.md §2.7): in JAX the whole
+DP/FSDP/TP/SP strategy is a set of PartitionSpecs and XLA emits the
+collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# A rule maps a logical axis name to one mesh axis, a tuple of mesh axes, or
+# None (replicated).
+Rules = Mapping[str, object]
+
+# Default layout: FSDP over the fsdp axis, megatron TP over tensor, batch over
+# (data, fsdp), sequence/context over context. Matches §2.7's inventory.
+DEFAULT_RULES: dict[str, object] = {
+    # activations
+    "batch": ("data", "fsdp"),
+    "seq": "context",             # sequence parallelism for activations
+    "act_embed": None,
+    "act_heads": "tensor",
+    "act_mlp": "tensor",
+    # params
+    "embed": "fsdp",              # ZeRO-3 style parameter sharding
+    "vocab": "tensor",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "layers": None,               # scan-over-layers stacking axis
+    "expert": "expert",
+}
+
+
+def pspec(names: Sequence[str | None], rules: Rules | None = None) -> PartitionSpec:
+    """Translate a tuple of logical axis names into a PartitionSpec."""
+    rules = rules if rules is not None else DEFAULT_RULES
+    out = []
+    for name in names:
+        if name is None:
+            out.append(None)
+        else:
+            if name not in rules:
+                raise KeyError(f"no sharding rule for logical axis {name!r}")
+            out.append(rules[name])
+    return PartitionSpec(*out)
+
+
+def named_sharding(
+    mesh: Mesh, names: Sequence[str | None], rules: Rules | None = None
+) -> NamedSharding:
+    return NamedSharding(mesh, pspec(names, rules))
+
+
+def tree_pspecs(logical_tree, rules: Rules | None = None):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda names: pspec(names, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def tree_shardings(mesh: Mesh, logical_tree, rules: Rules | None = None):
+    return jax.tree_util.tree_map(
+        lambda names: named_sharding(mesh, names, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def constrain(x, names: Sequence[str | None], rules: Rules | None = None):
+    """Apply a logical sharding constraint inside jit (no-op outside a mesh)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, pspec(names, rules))
+    except (ValueError, RuntimeError):
+        # No ambient mesh (e.g. pure single-device eval) — constraint is moot.
+        return x
+
+
+def validate_divisibility(mesh: Mesh, logical_tree, shapes_tree, rules=None):
+    """Check every sharded dim divides evenly; raise with a readable message.
+
+    Run at trainer setup so layout bugs surface before a 40s XLA compile.
+    """
+    specs = tree_pspecs(logical_tree, rules)
+
+    def _check(path, spec, shape):
+        for dim, part in zip(shape, spec):
+            if part is None:
+                continue
+            axes = part if isinstance(part, tuple) else (part,)
+            total = 1
+            for a in axes:
+                total *= mesh.shape[a]
+            if dim % total != 0:
+                raise ValueError(
+                    f"param {jax.tree_util.keystr(path)}: dim {dim} not divisible by "
+                    f"mesh axes {axes} (product {total})"
+                )
+
+    jax.tree_util.tree_map_with_path(
+        _check, specs, shapes_tree, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )

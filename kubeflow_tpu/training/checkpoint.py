@@ -1,0 +1,50 @@
+"""Checkpoint/resume via Orbax — first-class, because slice restart is the
+normal failure mode at scale (SURVEY.md §5 checkpoint/resume: the reference
+delegates this to user code; we own it).
+
+Async checkpointing: the device->host copy happens at `save()`, serialization
+runs in a background thread so the step loop keeps going.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True, enable_async_checkpointing=async_save
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, force: bool = False):
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, step: int | None = None, template: Any = None):
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None, None
+        if template is not None:
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        else:
+            state = self._mgr.restore(step)
+        return step, state
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

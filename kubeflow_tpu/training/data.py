@@ -1,0 +1,58 @@
+"""Data loading: sharded host->device feeding.
+
+Provides a synthetic LM token stream (benchmarks, tests) and a generic
+host-array feeder that places global batches onto the mesh with the
+(data, fsdp) batch sharding. Multi-host: each process feeds only its local
+shard via `jax.make_array_from_process_local_data`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+
+
+def put_batch(mesh: Mesh, batch):
+    """Place a host pytree onto the mesh, sharded over the batch dim."""
+    sh = batch_sharding(mesh)
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return jax.device_put(batch, sh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sh, x), batch
+    )
+
+
+def synthetic_lm_batches(
+    vocab_size: int, global_batch: int, seq_len: int, seed: int = 0
+) -> Iterator[dict]:
+    """Infinite synthetic token batches: {"tokens": [B, S+1]} on host.
+
+    Multi-host aware: yields only this process's slice of the global batch.
+    """
+    rng = np.random.default_rng(seed + jax.process_index())
+    n_proc = jax.process_count()
+    local = global_batch // n_proc
+    while True:
+        yield {
+            "tokens": rng.integers(
+                0, vocab_size, (local, seq_len + 1), dtype=np.int32
+            )
+        }
+
+
+def mnist_synthetic(batch: int, seed: int = 0) -> Iterator[dict]:
+    """Synthetic MNIST-shaped batches (CPU baseline config, BASELINE.json:7)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "image": rng.normal(size=(batch, 28, 28, 1)).astype(np.float32),
+            "label": rng.integers(0, 10, (batch,), dtype=np.int32),
+        }

@@ -1,0 +1,169 @@
+"""pjit training loop: sharded train state, fused train step, grad accumulation.
+
+The reference delegates all of this to user containers (SURVEY.md §2.7 — the
+operator only does rendezvous); here it is a first-party framework feature.
+One train step is a single jitted function with explicit in/out shardings; XLA
+emits all collectives (gradient all-reduce over `data`+`fsdp`, weight
+all-gathers for FSDP, TP collectives) from the sharding annotations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from kubeflow_tpu.ops.losses import softmax_cross_entropy
+from kubeflow_tpu.parallel import sharding as shd
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    grad_accum: int = 1
+    rules: Mapping[str, object] | None = None   # logical->mesh rules override
+
+
+def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, cfg.learning_rate, cfg.warmup_steps, max(cfg.total_steps, cfg.warmup_steps + 1),
+        end_value=cfg.learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
+    )
+
+
+class Trainer:
+    """Builds and owns the sharded train state + compiled step.
+
+    loss_fn(params, batch) -> (loss, metrics_dict). `batch` is a pytree whose
+    leaves' leading dim is the global batch (sharded over data+fsdp).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        init_params_fn: Callable[[jax.Array], Any],
+        params_logical_axes,
+        loss_fn: Callable[[Any, Any], tuple[jax.Array, dict]],
+        config: TrainerConfig,
+        donate_state: bool = True,
+    ):
+        self.mesh = mesh
+        self.config = config
+        self.loss_fn = loss_fn
+        self.optimizer = make_optimizer(config)
+        rules = config.rules or shd.DEFAULT_RULES
+
+        self.param_specs = shd.tree_pspecs(params_logical_axes, rules)
+        self.param_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        self.batch_sharding = NamedSharding(
+            mesh, PartitionSpec(("data", "fsdp"))
+        )
+
+        # init params directly into their shards (no host-side full copy);
+        # optimizer state inherits param shardings through propagation.
+        self._init_jit = jax.jit(init_params_fn, out_shardings=self.param_shardings)
+        self._opt_init = jax.jit(self.optimizer.init)
+
+        self.step_fn = self._build_step(donate_state)
+        self.params = None
+        self.opt_state = None
+        self.step = 0
+
+    def init_state(self, rng: jax.Array):
+        self.params = self._init_jit(rng)
+        self.opt_state = self._opt_init(self.params)
+        self.step = 0
+        return self.params
+
+    def _build_step(self, donate: bool):
+        optimizer = self.optimizer
+        loss_fn = self.loss_fn
+        accum = self.config.grad_accum
+        mesh = self.mesh
+
+        def grads_of(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            return loss, metrics, grads
+
+        def step(params, opt_state, batch):
+            if accum > 1:
+                # split leading batch dim into [accum, micro, ...] and scan
+                micro = jax.tree_util.tree_map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                    batch,
+                )
+
+                def body(carry, mb):
+                    g_acc, loss_acc = carry
+                    loss, _, grads = grads_of(params, mb)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                    return (g_acc, loss_acc + loss), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                )
+                (grads, loss), _ = jax.lax.scan(body, (zeros, 0.0), micro)
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
+                metrics = {}
+            else:
+                loss, metrics, grads = grads_of(params, batch)
+
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            gnorm = optax.global_norm(grads)
+            metrics = dict(metrics, loss=loss, grad_norm=gnorm)
+            return params, opt_state, metrics
+
+        donate_argnums = (0, 1) if donate else ()
+        # shardings propagate from the arguments (params/opt_state placed at
+        # init, batch placed by the data loader via self.batch_sharding)
+        return jax.jit(step, donate_argnums=donate_argnums)
+
+    def train_step(self, batch):
+        self.params, self.opt_state, metrics = self.step_fn(
+            self.params, self.opt_state, batch
+        )
+        self.step += 1
+        return metrics
+
+
+def lm_loss_fn(forward, cfg):
+    """Next-token LM loss for a model `forward(params, tokens, cfg)`.
+
+    Batch: {"tokens": [B, S+1] int32, "mask": optional [B, S+1]}.
+    """
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = forward(params, inputs, cfg)
+        mask = batch.get("mask")
+        mask = mask[:, 1:] if mask is not None else None
+        loss, aux = softmax_cross_entropy(
+            logits, targets, mask, z_loss=getattr(cfg, "z_loss", 0.0)
+        )
+        return loss, {"tokens": aux["total_weight"]}
+
+    return loss_fn

@@ -1,0 +1,1 @@
+from kubeflow_tpu.utils.pytree import tree_size_bytes, tree_param_count, map_with_path

@@ -1,0 +1,40 @@
+"""Small pytree helpers used across the data plane."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_param_count(tree) -> int:
+    """Total number of scalar parameters in a pytree of arrays."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_size_bytes(tree) -> int:
+    """Total size in bytes of a pytree of arrays."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+
+
+def map_with_path(fn, tree):
+    """tree_map where fn receives (path_tuple_of_str, leaf)."""
+
+    def _fn(path, leaf):
+        keys = tuple(
+            k.key if hasattr(k, "key") else (k.name if hasattr(k, "name") else str(k.idx))
+            for k in path
+        )
+        return fn(keys, leaf)
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)
+
+
+def cast_floating(tree, dtype):
+    """Cast floating-point leaves of a pytree to `dtype`, leave others alone."""
+
+    def _cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)

@@ -1,0 +1,39 @@
+"""Test config: force CPU with 8 virtual devices BEFORE any backend init.
+
+This is the SURVEY.md §4 'distributed without a cluster' translation: all
+mesh/sharding/collective logic is exercised on an 8-device CPU mesh in CI,
+mirroring how the reference tests controllers with envtest and fake clients
+instead of real GPUs.
+
+NOTE on this environment: a sitecustomize hook may pre-register a remote TPU
+platform and force `jax_platforms` via jax.config.update (which overrides the
+JAX_PLATFORMS env var). We therefore (a) set the XLA device-count flag via
+env before jax import, and (b) re-force `jax_platforms=cpu` via config.update,
+which takes precedence because no backend has initialized yet.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from kubeflow_tpu.parallel import MeshConfig, build_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """2x2x2 mesh: data=2, fsdp=2, tensor=2."""
+    return build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_fsdp8():
+    return build_mesh(MeshConfig(fsdp=8))
