@@ -1,0 +1,102 @@
+"""Round benchmark: Llama train-step throughput on the available TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md): the north-star metric is
+tokens/sec/chip and the target is >=40% MFU (BASELINE.json:5), so
+vs_baseline is reported as achieved_MFU / 0.40.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Per-chip peak bf16 FLOP/s by TPU generation (public figures).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 5e11,  # nominal, so the script degrades gracefully off-TPU
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel import single_device_mesh
+    from kubeflow_tpu.training import (
+        Trainer, TrainerConfig, lm_loss_fn, put_batch, synthetic_lm_batches,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        cfg = llama.llama_1b(remat="full", attn_impl="xla")
+        global_batch, seq = 8, 2048
+        steps, warmup = 20, 3
+    else:
+        cfg = llama.llama_tiny()
+        global_batch, seq = 8, 128
+        steps, warmup = 5, 1
+
+    mesh = single_device_mesh(dev)
+    trainer = Trainer(
+        mesh=mesh,
+        init_params_fn=lambda rng: llama.init_params(rng, cfg),
+        params_logical_axes=llama.param_logical_axes(cfg),
+        loss_fn=lm_loss_fn(llama.forward, cfg),
+        config=TrainerConfig(
+            learning_rate=3e-4, warmup_steps=10, total_steps=1000
+        ),
+    )
+    trainer.init_state(jax.random.key(0))
+
+    batches = synthetic_lm_batches(cfg.vocab_size, global_batch, seq)
+    batch = put_batch(mesh, next(iter(batches)))
+
+    for _ in range(warmup):
+        m = trainer.train_step(batch)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = trainer.train_step(batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = global_batch * seq
+    tok_per_sec = tokens_per_step * steps / dt
+    mfu = tok_per_sec * cfg.flops_per_token() / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama1b_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "seq": seq,
+            "global_batch": global_batch,
+            "steps": steps,
+            "step_time_ms": round(1000 * dt / steps, 2),
+            "loss": round(float(m["loss"]), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

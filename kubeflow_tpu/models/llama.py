@@ -154,7 +154,7 @@ def param_logical_axes(cfg: LlamaConfig):
 # Forward
 # ---------------------------------------------------------------------------
 
-def _block(x, lp, inv_freq, positions, cfg: LlamaConfig):
+def _block(x, lp, inv_freq, positions, cfg: LlamaConfig, mesh=None):
     """One transformer block. x: [B,S,D] in compute dtype."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
@@ -164,7 +164,17 @@ def _block(x, lp, inv_freq, positions, cfg: LlamaConfig):
     k = constrain(k, ("batch", "seq", None, None))
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
-    o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    if cfg.attn_impl in ("ring", "ulysses"):
+        from kubeflow_tpu.parallel.ring_attention import (
+            ring_attention, ulysses_attention,
+        )
+
+        if mesh is None:
+            raise ValueError(f"attn_impl={cfg.attn_impl!r} requires mesh=")
+        attn_fn = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+        o = attn_fn(q, k, v, mesh, causal=True)
+    else:
+        o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
     o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
     x = x + constrain(o, ("batch", "seq", "act_embed"))
 
@@ -185,8 +195,12 @@ def _remat_wrap(fn, cfg: LlamaConfig):
     return jax.checkpoint(fn)
 
 
-def forward(params, tokens, cfg: LlamaConfig, positions=None):
-    """Full-sequence forward. tokens: [B,S] int32 -> logits [B,S,V] (f32)."""
+def forward(params, tokens, cfg: LlamaConfig, positions=None, mesh=None):
+    """Full-sequence forward. tokens: [B,S] int32 -> logits [B,S,V] (f32).
+
+    `mesh` is only needed for the context-parallel attention impls
+    ("ring"/"ulysses"), which run shard_map collectives over it.
+    """
     if positions is None:
         positions = jnp.arange(tokens.shape[1])[None, :]
     inv_freq = jnp.asarray(rope_frequencies(
@@ -197,7 +211,7 @@ def forward(params, tokens, cfg: LlamaConfig, positions=None):
     x = constrain(x, ("batch", "seq", "act_embed"))
 
     block = _remat_wrap(
-        lambda x, lp: (_block(x, lp, inv_freq, positions, cfg), None), cfg
+        lambda x, lp: (_block(x, lp, inv_freq, positions, cfg, mesh), None), cfg
     )
     x, _ = jax.lax.scan(block, x, params["layers"])
 
