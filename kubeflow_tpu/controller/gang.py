@@ -1,0 +1,90 @@
+"""Gang scheduling: all-or-nothing admission of a job's pod group.
+
+The reference delegates this to volcano/scheduler-plugins PodGroups
+(SURVEY.md §2.1 'Gang-scheduling glue', §7 hard part #1: partial-slice
+deadlock is the failure mode). TPU slices make it stricter: a JAXJob's
+workers are the hosts of ONE slice — placing some of them is useless, so
+admission is atomic over slice capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class PodGroup:
+    name: str
+    namespace: str
+    min_member: int
+    queue: str = "default"
+    priority: int = 0
+    admitted: bool = False
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class SlicePool:
+    """Capacity of one TPU slice type (e.g. 16 hosts of v5p in 4 slices)."""
+
+    accelerator: str = "any"
+    total_hosts: int = 64
+    free_hosts: int = 64
+
+
+class GangScheduler:
+    """Priority/FIFO queue with atomic admission against host capacity.
+
+    Admission is all-or-nothing per PodGroup: either `min_member` hosts are
+    reserved atomically or the group stays queued — no partial placement, no
+    deadlock from two half-placed jobs holding each other's hosts.
+    """
+
+    def __init__(self, pools: Optional[dict[str, SlicePool]] = None):
+        self.pools = pools or {"any": SlicePool()}
+        self.groups: dict[tuple[str, str], PodGroup] = {}
+        self.reservations: dict[tuple[str, str], tuple[str, int]] = {}
+
+    def add_group(self, group: PodGroup, accelerator: str = "any") -> None:
+        key = (group.namespace, group.name)
+        if key not in self.groups:
+            self.groups[key] = group
+            self.reservations.setdefault(key, (accelerator, 0))
+
+    def remove_group(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        group = self.groups.pop(key, None)
+        acc, held = self.reservations.pop(key, ("any", 0))
+        if group and held:
+            self.pools[acc].free_hosts += held
+
+    def try_admit(self) -> list[PodGroup]:
+        """Admit queued groups in priority order (then FIFO). Returns newly
+        admitted groups."""
+        admitted = []
+        pending = sorted(
+            (g for g in self.groups.values() if not g.admitted),
+            key=lambda g: (-g.priority, g.created_at),
+        )
+        for group in pending:
+            key = (group.namespace, group.name)
+            acc, _ = self.reservations[key]
+            pool = self.pools.get(acc) or self.pools.get("any")
+            if pool is None:
+                continue
+            if pool.free_hosts >= group.min_member:
+                pool.free_hosts -= group.min_member
+                self.reservations[key] = (acc if acc in self.pools else "any",
+                                          group.min_member)
+                group.admitted = True
+                admitted.append(group)
+            # strict FIFO head-of-line within a pool would starve large jobs
+            # forever under churn; we keep scanning so smaller jobs backfill,
+            # but priority ordering ensures head jobs win ties.
+        return admitted
+
+    def is_admitted(self, namespace: str, name: str) -> bool:
+        g = self.groups.get((namespace, name))
+        return bool(g and g.admitted)

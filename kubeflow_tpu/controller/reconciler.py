@@ -1,0 +1,320 @@
+"""Generic job reconciler — the heart of the training layer.
+
+Mirrors the reference's common JobController capabilities (SURVEY.md §2.1:
+ReconcileJobs/ReconcilePods/ReconcileServices/UpdateJobStatus + §3.1 call
+stack): idempotent reconcile from desired spec to pods/services, gang
+admission, per-kind rendezvous env injection, status aggregation, restart
+with backoff, TTL cleanup. TPU-first differences:
+
+- Rendezvous env is the jax.distributed contract (KFT_COORDINATOR /
+  KFT_NUM_PROCESSES / KFT_PROCESS_ID + KFT_MESH topology), not
+  MASTER_ADDR/NCCL (SURVEY.md §2.8). TF_CONFIG is still produced for the
+  TFJob-compat kind.
+- Failure domain is the whole slice: any worker failure triggers a gang
+  restart (delete ALL pods, re-admit) because ICI collectives cannot survive
+  a member loss; recovery is checkpoint-resume (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+from kubeflow_tpu.api.types import (
+    CleanPodPolicy, Condition, ConditionType, JobSpec, JobStatus, ReplicaStatus,
+    ReplicaType, RestartPolicy, validate,
+)
+from kubeflow_tpu.controller.cluster import (
+    Cluster, LocalProcessCluster, Pod, PodPhase, Service,
+)
+from kubeflow_tpu.controller.gang import GangScheduler, PodGroup
+
+COORDINATOR_PORT = 8476
+
+
+def pod_name(job: JobSpec, rtype: str, index: int) -> str:
+    return f"{job.name}-{rtype.lower()}-{index}"
+
+
+def _job_selector(job: JobSpec) -> dict[str, str]:
+    return {"job-name": job.name, "job-uid": job.uid}
+
+
+class JobController:
+    """Reconciles JobSpecs against a Cluster. Also plays the apiserver role:
+    `submit`/`get`/`delete` mutate the job store, `reconcile` converges it."""
+
+    def __init__(self, cluster: Cluster, scheduler: Optional[GangScheduler] = None):
+        self.cluster = cluster
+        self.scheduler = scheduler or GangScheduler()
+        self.jobs: dict[tuple[str, str], JobSpec] = {}
+        self.metrics: dict[str, float] = {}   # controller-level observability
+
+    # ---------------- apiserver-ish surface ----------------
+
+    def submit(self, job: JobSpec) -> JobSpec:
+        validate(job)
+        key = (job.namespace, job.name)
+        if key in self.jobs:
+            raise KeyError(f"job {key} already exists")
+        job.uid = job.uid or uuid.uuid4().hex[:12]
+        job.status = JobStatus()
+        self._set_condition(job, ConditionType.CREATED, "JobCreated")
+        job.status.start_time = time.time()
+        self.jobs[key] = job
+        # register the gang group at submission so a later admission cycle
+        # sees all queued jobs and can order by priority, not arrival
+        if job.run_policy.scheduling.gang and not job.run_policy.suspend:
+            self._ensure_podgroup(job)
+        return job
+
+    def get(self, namespace: str, name: str) -> Optional[JobSpec]:
+        return self.jobs.get((namespace, name))
+
+    def delete(self, namespace: str, name: str) -> None:
+        job = self.jobs.pop((namespace, name), None)
+        if job:
+            self._delete_pods(job)
+            self.cluster.delete_service(namespace, job.name)
+            self.scheduler.remove_group(namespace, job.name)
+
+    # ---------------- reconcile ----------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[JobSpec]:
+        t0 = time.perf_counter()
+        job = self.jobs.get((namespace, name))
+        if job is None:
+            return None
+        if job.run_policy.suspend:
+            self._set_condition(job, ConditionType.SUSPENDED, "JobSuspended")
+            self._delete_pods(job)
+            return job
+        if job.status.is_finished():
+            self._maybe_cleanup(job)
+            return job
+
+        self._ensure_service(job)
+        if job.run_policy.scheduling.gang:
+            self._ensure_podgroup(job)
+            self.scheduler.try_admit()
+        self._ensure_pods(job)
+        self._start_admitted(job)
+        self._update_status(job)
+        self._check_deadline(job)
+        self.metrics["reconcile_seconds"] = time.perf_counter() - t0
+        return job
+
+    def run_to_completion(
+        self, namespace: str, name: str, timeout: float = 120.0, poll: float = 0.2
+    ) -> JobSpec:
+        """Poll-reconcile until the job finishes (local/e2e driver)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self.reconcile(namespace, name)
+            if job is None:
+                raise KeyError(f"job {namespace}/{name} not found")
+            if job.status.is_finished():
+                return job
+            time.sleep(poll)
+        raise TimeoutError(f"job {namespace}/{name} did not finish in {timeout}s")
+
+    # ---------------- internals ----------------
+
+    def _ensure_service(self, job: JobSpec) -> None:
+        if self.cluster.get_service(job.namespace, job.name) is None:
+            self.cluster.create_service(Service(
+                name=job.name, namespace=job.namespace,
+                selector=_job_selector(job), port=COORDINATOR_PORT,
+            ))
+
+    def _ensure_podgroup(self, job: JobSpec) -> None:
+        sched = job.run_policy.scheduling
+        accel = "any"
+        for spec in job.replica_specs.values():
+            if spec.template.tpu is not None:
+                accel = spec.template.tpu.accelerator
+        self.scheduler.add_group(
+            PodGroup(
+                name=job.name, namespace=job.namespace,
+                min_member=sched.min_available or job.total_replicas,
+                queue=sched.queue, priority=sched.priority,
+            ),
+            accelerator=accel,
+        )
+
+    def _ensure_pods(self, job: JobSpec) -> None:
+        for rtype, spec in job.replica_specs.items():
+            for i in range(spec.replicas):
+                name = pod_name(job, rtype, i)
+                if self.cluster.get_pod(job.namespace, name) is None:
+                    env = self.cluster_env(job, rtype, i)
+                    env.update(spec.template.env)
+                    self.cluster.create_pod(Pod(
+                        name=name, namespace=job.namespace,
+                        labels={**_job_selector(job), "replica-type": rtype,
+                                "replica-index": str(i)},
+                        env=env,
+                        command=list(spec.template.command),
+                    ))
+
+    def _start_admitted(self, job: JobSpec) -> None:
+        admitted = (
+            not job.run_policy.scheduling.gang
+            or self.scheduler.is_admitted(job.namespace, job.name)
+        )
+        if not admitted:
+            return
+        for pod in self.cluster.list_pods(job.namespace, _job_selector(job)):
+            if pod.phase == PodPhase.PENDING and not pod.scheduled:
+                pod.scheduled = True
+                if isinstance(self.cluster, LocalProcessCluster):
+                    self.cluster.start_pod(pod)
+
+    def cluster_env(self, job: JobSpec, rtype: str, index: int) -> dict[str, str]:
+        """Per-kind rendezvous env (the reference's SetClusterSpec equivalent)."""
+        coordinator = self.cluster.resolve(job.namespace, job.name)
+        if job.kind == "JAXJob":
+            workers = job.replica_specs[ReplicaType.WORKER.value].replicas
+            # process_id from pod ordinal: the SURVEY.md §2.8 contract
+            env = {
+                "KFT_COORDINATOR": coordinator,
+                "KFT_NUM_PROCESSES": str(workers),
+                "KFT_PROCESS_ID": str(index),
+                "KFT_JOB_NAME": job.name,
+                "KFT_REPLICA_TYPE": rtype,
+                "TPU_WORKER_ID": str(index),
+            }
+            spec = job.replica_specs[rtype]
+            if spec.template.tpu is not None:
+                env["KFT_TPU_ACCELERATOR"] = spec.template.tpu.accelerator
+                env["KFT_TPU_TOPOLOGY"] = spec.template.tpu.topology
+            return env
+        if job.kind == "TFJob":
+            cluster: dict[str, list[str]] = {}
+            for rt, spec in job.replica_specs.items():
+                hosts = [
+                    f"{pod_name(job, rt, i)}.{job.namespace}.svc:2222"
+                    for i in range(spec.replicas)
+                ]
+                cluster[rt.lower()] = hosts
+            tf_config = {
+                "cluster": cluster,
+                "task": {"type": rtype.lower(), "index": index},
+            }
+            return {"TF_CONFIG": json.dumps(tf_config)}
+        return {"KFT_COORDINATOR": coordinator}
+
+    def _update_status(self, job: JobSpec) -> None:
+        pods = self.cluster.list_pods(job.namespace, _job_selector(job))
+        stats: dict[str, ReplicaStatus] = {}
+        for rtype in job.replica_specs:
+            stats[rtype] = ReplicaStatus()
+        any_failed = False
+        for pod in pods:
+            if pod is None:
+                continue
+            rtype = pod.labels.get("replica-type", "")
+            rs = stats.setdefault(rtype, ReplicaStatus())
+            if pod.phase == PodPhase.RUNNING:
+                rs.active += 1
+            elif pod.phase == PodPhase.SUCCEEDED:
+                rs.succeeded += 1
+            elif pod.phase == PodPhase.FAILED:
+                rs.failed += 1
+                any_failed = True
+        job.status.replica_statuses = stats
+
+        success_rtype, success_index = self._success_anchor(job)
+        anchor = next(
+            (p for p in pods if p is not None
+             and p.labels.get("replica-type") == success_rtype
+             and p.labels.get("replica-index") == str(success_index)),
+            None,
+        )
+
+        if any_failed:
+            self._handle_failure(job)
+            return
+        if anchor is not None and anchor.phase == PodPhase.SUCCEEDED:
+            self._set_condition(job, ConditionType.SUCCEEDED, "JobSucceeded")
+            job.status.completion_time = time.time()
+            self._maybe_cleanup(job)
+            return
+        total_active = sum(rs.active for rs in stats.values())
+        if total_active == job.total_replicas:
+            self._set_condition(job, ConditionType.RUNNING, "JobRunning")
+
+    def _success_anchor(self, job: JobSpec) -> tuple[str, int]:
+        """Replica whose success marks job success (reference: chief/worker-0)."""
+        for rt in (ReplicaType.CHIEF.value, ReplicaType.COORDINATOR.value,
+                   ReplicaType.WORKER.value):
+            if rt in job.replica_specs:
+                return rt, 0
+        return next(iter(job.replica_specs)), 0
+
+    def _handle_failure(self, job: JobSpec) -> None:
+        policy = self._restart_policy(job)
+        retryable = policy in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS,
+                               RestartPolicy.EXIT_CODE)
+        if policy == RestartPolicy.EXIT_CODE:
+            pods = self.cluster.list_pods(job.namespace, _job_selector(job))
+            retryable = any(
+                p is not None and p.phase == PodPhase.FAILED
+                and (p.exit_code or 0) >= 128
+                for p in pods
+            )
+        if retryable and job.status.restart_count < job.run_policy.backoff_limit:
+            job.status.restart_count += 1
+            self._set_condition(
+                job, ConditionType.RESTARTING,
+                f"GangRestart#{job.status.restart_count}",
+                "worker failure => whole-slice restart (ICI not elastic)",
+            )
+            # gang restart: tear down everything, drop the reservation, requeue
+            self._delete_pods(job)
+            self.scheduler.remove_group(job.namespace, job.name)
+        else:
+            self._set_condition(job, ConditionType.FAILED, "BackoffLimitExceeded")
+            job.status.completion_time = time.time()
+            self._maybe_cleanup(job)
+
+    def _restart_policy(self, job: JobSpec) -> RestartPolicy:
+        w = job.replica_specs.get(ReplicaType.WORKER.value)
+        return w.restart_policy if w else RestartPolicy.NEVER
+
+    def _check_deadline(self, job: JobSpec) -> None:
+        deadline = job.run_policy.active_deadline_seconds
+        if deadline and job.status.start_time:
+            if time.time() - job.status.start_time > deadline:
+                self._set_condition(job, ConditionType.FAILED, "DeadlineExceeded")
+                job.status.completion_time = time.time()
+                self._delete_pods(job)
+
+    def _maybe_cleanup(self, job: JobSpec) -> None:
+        policy = job.run_policy.clean_pod_policy
+        if policy == CleanPodPolicy.ALL:
+            self._delete_pods(job)
+        elif policy == CleanPodPolicy.RUNNING:
+            for pod in self.cluster.list_pods(job.namespace, _job_selector(job)):
+                if pod is not None and pod.phase == PodPhase.RUNNING:
+                    self.cluster.delete_pod(job.namespace, pod.name)
+        ttl = job.run_policy.ttl_seconds_after_finished
+        if ttl is not None and job.status.completion_time:
+            if time.time() - job.status.completion_time > ttl:
+                self.delete(job.namespace, job.name)
+
+    def _delete_pods(self, job: JobSpec) -> None:
+        for pod in list(self.cluster.list_pods(job.namespace, _job_selector(job))):
+            if pod is not None:
+                self.cluster.delete_pod(job.namespace, pod.name)
+
+    def _set_condition(
+        self, job: JobSpec, ctype: ConditionType, reason: str = "", message: str = ""
+    ) -> None:
+        if job.status.condition() == ctype:
+            return
+        job.status.conditions.append(
+            Condition(type=ctype, reason=reason, message=message)
+        )

@@ -1,0 +1,1 @@
+from kubeflow_tpu.rendezvous.bootstrap import WorldInfo, initialize, world_from_env

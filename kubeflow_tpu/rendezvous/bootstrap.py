@@ -1,0 +1,73 @@
+"""In-worker bootstrap: operator-injected env -> initialized JAX world + mesh.
+
+The worker-side half of the rendezvous contract (SURVEY.md §2.8): the
+controller stamps KFT_COORDINATOR / KFT_NUM_PROCESSES / KFT_PROCESS_ID (+
+KFT_MESH / KFT_DCN topology), and this module turns them into
+`jax.distributed.initialize()` + a canonical device mesh. The TPU-native
+replacement for torchrun/TF_CONFIG/MPI-hostfile bootstrap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class WorldInfo:
+    coordinator: str
+    num_processes: int
+    process_id: int
+    job_name: str = ""
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def world_from_env(env: Optional[dict] = None) -> WorldInfo:
+    env = env if env is not None else os.environ
+    return WorldInfo(
+        coordinator=env.get("KFT_COORDINATOR", "127.0.0.1:8476"),
+        num_processes=int(env.get("KFT_NUM_PROCESSES", "1")),
+        process_id=int(env.get("KFT_PROCESS_ID", "0")),
+        job_name=env.get("KFT_JOB_NAME", ""),
+    )
+
+
+def initialize(env: Optional[dict] = None, timeout_s: float = 300.0):
+    """jax.distributed.initialize() from operator env; returns (world, mesh).
+
+    Single-process jobs skip distributed init entirely (one less failure
+    mode, and the common local/dev case).
+    """
+    import jax
+
+    world = world_from_env(env)
+    if world.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=world.coordinator,
+            num_processes=world.num_processes,
+            process_id=world.process_id,
+            initialization_timeout=int(timeout_s),
+        )
+    from kubeflow_tpu.parallel.mesh import mesh_from_topology_env
+
+    mesh = mesh_from_topology_env(dict(env if env is not None else os.environ))
+    return world, mesh
+
+
+def wait_for_workers(world: WorldInfo, deadline_s: float = 300.0) -> None:
+    """Barrier on world size: jax.device_count() must reach the global count."""
+    import jax
+
+    t0 = time.time()
+    expected = world.num_processes * jax.local_device_count()
+    while jax.device_count() < expected:
+        if time.time() - t0 > deadline_s:
+            raise TimeoutError(
+                f"only {jax.device_count()}/{expected} devices after {deadline_s}s"
+            )
+        time.sleep(1.0)

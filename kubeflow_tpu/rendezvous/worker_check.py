@@ -1,0 +1,56 @@
+"""Smoke workload for JAXJob e2e: rendezvous + a cross-process collective.
+
+Run as ``python -m kubeflow_tpu.rendezvous.worker_check`` inside a pod. Reads
+the operator env contract, initializes the distributed world, verifies the
+global device count, runs a psum across the whole world, and writes metrics.
+Exit 0 = healthy world. This is the 'MNIST-class CPU stand-in image' role
+from the reference's e2e strategy (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("KFT_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_FORCE_PLATFORM"])
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.rendezvous.bootstrap import initialize
+    from kubeflow_tpu.training.metrics import MetricsWriter
+
+    world, mesh = initialize()
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    expected = world.num_processes * n_local
+    assert n_global == expected, f"device_count {n_global} != {expected}"
+
+    # cross-process collective: global mean over a data-sharded array
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+    import numpy as np
+
+    local = np.full((n_local, 4), float(world.process_id), np.float32)
+    arr = jax.make_array_from_process_local_data(sharding, local)
+    total = float(jax.jit(jnp.sum)(arr))
+    expect_total = 4 * n_local * sum(range(world.num_processes))
+    assert abs(total - expect_total) < 1e-5, f"psum {total} != {expect_total}"
+
+    metrics_path = os.environ.get("KFT_METRICS_PATH")
+    if metrics_path:
+        MetricsWriter(metrics_path).write(
+            0, world_ok=1.0, process_id=world.process_id, total=total
+        )
+    print(f"worker {world.process_id}/{world.num_processes}: world ok, "
+          f"devices={n_global}, collective={total}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""Controller unit tests with FakeCluster — the reference's envtest pattern
+(SURVEY.md §4.2): pods are created but never run; tests drive phases by hand
+and assert reconcile behavior."""
+
+import pytest
+
+from kubeflow_tpu.api.types import (
+    ConditionType, RestartPolicy, RunPolicy, SchedulingPolicy, TPUSpec,
+    ValidationError, from_yaml, jax_job, tf_job, to_yaml, validate,
+)
+from kubeflow_tpu.controller import (
+    FakeCluster, GangScheduler, JobController, PodPhase, SlicePool, pod_name,
+)
+
+
+def make_controller(hosts=64):
+    cluster = FakeCluster()
+    sched = GangScheduler({"any": SlicePool(total_hosts=hosts, free_hosts=hosts)})
+    return JobController(cluster, sched), cluster
+
+
+def submit(ctl, job):
+    ctl.submit(job)
+    return ctl.reconcile(job.namespace, job.name)
+
+
+# ---------------- API types ----------------
+
+def test_yaml_roundtrip():
+    job = jax_job("train-llama", workers=4, tpu=TPUSpec("v5p", "2x2x1"),
+                  mesh={"fsdp": 8, "tensor": 4})
+    text = to_yaml(job)
+    back = from_yaml(text)
+    assert back.name == job.name
+    assert back.kind == "JAXJob"
+    assert back.replica_specs["Worker"].replicas == 4
+    assert back.replica_specs["Worker"].template.tpu.topology == "2x2x1"
+    assert back.replica_specs["Worker"].template.env["KFT_MESH"] == "fsdp=8,tensor=4"
+
+
+def test_validation():
+    with pytest.raises(ValidationError, match="replicas"):
+        validate(jax_job("j", workers=0))
+    with pytest.raises(ValidationError, match="mesh axis"):
+        validate(jax_job("j", workers=1, mesh={"bogus": 2}))
+    bad_tpu = jax_job("j", workers=1, tpu=TPUSpec("v5p", "3x1x1", chips_per_host=4))
+    with pytest.raises(ValidationError, match="divisible"):
+        validate(bad_tpu)
+    validate(jax_job("ok-job", workers=2, mesh={"data": 2}))
+
+
+# ---------------- reconcile lifecycle ----------------
+
+def test_pods_and_rendezvous_env():
+    ctl, cluster = make_controller()
+    job = submit(ctl, jax_job("rv", workers=3, mesh={"data": 3}))
+    pods = cluster.list_pods("default", {"job-name": "rv"})
+    assert len(pods) == 3
+    env0 = cluster.get_pod("default", pod_name(job, "Worker", 0)).env
+    env2 = cluster.get_pod("default", pod_name(job, "Worker", 2)).env
+    assert env0["KFT_PROCESS_ID"] == "0"
+    assert env2["KFT_PROCESS_ID"] == "2"
+    assert env0["KFT_NUM_PROCESSES"] == "3"
+    assert env0["KFT_COORDINATOR"] == env2["KFT_COORDINATOR"]
+    assert env0["KFT_MESH"] == "data=3"
+
+
+def test_tfjob_tf_config():
+    import json
+
+    ctl, cluster = make_controller()
+    job = submit(ctl, tf_job("tfj", workers=2, ps=1, chief=True))
+    env = cluster.get_pod("default", pod_name(job, "Worker", 1)).env
+    tf_config = json.loads(env["TF_CONFIG"])
+    assert tf_config["task"] == {"type": "worker", "index": 1}
+    assert len(tf_config["cluster"]["worker"]) == 2
+    assert len(tf_config["cluster"]["chief"]) == 1
+    assert len(tf_config["cluster"]["ps"]) == 1
+
+
+def test_success_when_worker0_succeeds():
+    ctl, cluster = make_controller()
+    job = submit(ctl, jax_job("ok", workers=2))
+    cluster.run_scheduled()
+    ctl.reconcile("default", "ok")
+    assert job.status.condition() == ConditionType.RUNNING
+    cluster.set_phase("default", pod_name(job, "Worker", 0), PodPhase.SUCCEEDED, 0)
+    ctl.reconcile("default", "ok")
+    assert job.status.condition() == ConditionType.SUCCEEDED
+    assert job.status.completion_time is not None
+
+
+def test_gang_restart_on_failure_then_backoff_failed():
+    ctl, cluster = make_controller()
+    job = submit(ctl, jax_job("flaky", workers=2,
+                              run_policy=RunPolicy(backoff_limit=1)))
+    cluster.run_scheduled()
+    ctl.reconcile("default", "flaky")
+    # worker-1 dies -> whole gang restarts (slice failure domain)
+    cluster.set_phase("default", pod_name(job, "Worker", 1), PodPhase.FAILED, 1)
+    ctl.reconcile("default", "flaky")
+    assert job.status.condition() == ConditionType.RESTARTING
+    assert job.status.restart_count == 1
+    assert cluster.list_pods("default", {"job-name": "flaky"}) == []
+    # pods recreated on next reconcile
+    ctl.reconcile("default", "flaky")
+    pods = cluster.list_pods("default", {"job-name": "flaky"})
+    assert len(pods) == 2
+    cluster.run_scheduled()
+    # second failure exceeds backoff_limit=1 -> Failed
+    cluster.set_phase("default", pod_name(job, "Worker", 0), PodPhase.FAILED, 1)
+    ctl.reconcile("default", "flaky")
+    assert job.status.condition() == ConditionType.FAILED
+
+
+def test_exit_code_policy_only_retries_retryable():
+    ctl, cluster = make_controller()
+    job = jax_job("ec", workers=1, run_policy=RunPolicy(backoff_limit=3))
+    job.replica_specs["Worker"].restart_policy = RestartPolicy.EXIT_CODE
+    submit(ctl, job)
+    cluster.run_scheduled()
+    cluster.set_phase("default", pod_name(job, "Worker", 0), PodPhase.FAILED, 1)
+    ctl.reconcile("default", "ec")
+    # exit 1 < 128: permanent failure, no retry
+    assert job.status.condition() == ConditionType.FAILED
+
+
+def test_gang_blocks_until_capacity():
+    ctl, cluster = make_controller(hosts=4)
+    big = submit(ctl, jax_job("big", workers=4))
+    small = submit(ctl, jax_job("small", workers=2))
+    cluster.run_scheduled()
+    # big got all 4 hosts; small must not be scheduled at all (no partial)
+    big_pods = cluster.list_pods("default", {"job-name": "big"})
+    small_pods = cluster.list_pods("default", {"job-name": "small"})
+    assert all(p.scheduled for p in big_pods)
+    assert all(not p.scheduled for p in small_pods)
+    # big finishes -> its reservation frees -> small admits
+    for i in range(4):
+        cluster.set_phase("default", pod_name(big, "Worker", i), PodPhase.SUCCEEDED, 0)
+    ctl.reconcile("default", "big")
+    ctl.delete("default", "big")
+    ctl.reconcile("default", "small")
+    cluster.run_scheduled()
+    small_pods = cluster.list_pods("default", {"job-name": "small"})
+    assert all(p.scheduled for p in small_pods)
+
+
+def test_suspend_tears_down_pods():
+    ctl, cluster = make_controller()
+    job = submit(ctl, jax_job("susp", workers=2))
+    assert len(cluster.list_pods("default", {"job-name": "susp"})) == 2
+    job.run_policy.suspend = True
+    ctl.reconcile("default", "susp")
+    assert job.status.condition() == ConditionType.SUSPENDED
+    assert cluster.list_pods("default", {"job-name": "susp"}) == []
+
+
+def test_priority_admission_order():
+    ctl, _ = make_controller(hosts=2)
+    low = jax_job("low", workers=2)
+    high = jax_job("high", workers=2,
+                   run_policy=RunPolicy(scheduling=SchedulingPolicy(priority=10)))
+    ctl.submit(low)
+    ctl.submit(high)
+    # one reconcile pass admits by priority: high wins the 2 hosts
+    ctl.reconcile("default", "low")
+    ctl.reconcile("default", "high")
+    assert ctl.scheduler.is_admitted("default", "high")
+    assert not ctl.scheduler.is_admitted("default", "low")
