@@ -25,7 +25,10 @@ class TrainingClient:
         self.namespace = namespace
 
     def create_job(self, job: JobSpec) -> JobSpec:
-        job.namespace = job.namespace or self.namespace
+        # "default" is JobSpec's unset sentinel: such jobs land in the
+        # client's namespace so create/get/wait all use the same key.
+        if not job.namespace or job.namespace == "default":
+            job.namespace = self.namespace
         submitted = self.controller.submit(job)
         self.controller.reconcile(job.namespace, job.name)
         return submitted

@@ -90,6 +90,9 @@ class JobController:
         if job.run_policy.suspend:
             self._set_condition(job, ConditionType.SUSPENDED, "JobSuspended")
             self._delete_pods(job)
+            # release the gang reservation — a suspended job must not hold
+            # slice capacity
+            self.scheduler.remove_group(job.namespace, job.name)
             return job
         if job.status.is_finished():
             self._maybe_cleanup(job)
@@ -176,12 +179,22 @@ class JobController:
         """Per-kind rendezvous env (the reference's SetClusterSpec equivalent)."""
         coordinator = self.cluster.resolve(job.namespace, job.name)
         if job.kind == "JAXJob":
-            workers = job.replica_specs[ReplicaType.WORKER.value].replicas
-            # process_id from pod ordinal: the SURVEY.md §2.8 contract
+            # Global process ids across ALL replica types (Coordinator first),
+            # so a {Coordinator: 1, Worker: N} job forms one N+1-process world
+            # with unique ids — the SURVEY.md §2.8 pod-ordinal contract.
+            order = sorted(
+                job.replica_specs,
+                key=lambda rt: (rt != ReplicaType.COORDINATOR.value, rt),
+            )
+            offset = 0
+            for rt in order:
+                if rt == rtype:
+                    break
+                offset += job.replica_specs[rt].replicas
             env = {
                 "KFT_COORDINATOR": coordinator,
-                "KFT_NUM_PROCESSES": str(workers),
-                "KFT_PROCESS_ID": str(index),
+                "KFT_NUM_PROCESSES": str(job.total_replicas),
+                "KFT_PROCESS_ID": str(offset + index),
                 "KFT_JOB_NAME": job.name,
                 "KFT_REPLICA_TYPE": rtype,
                 "TPU_WORKER_ID": str(index),
@@ -260,9 +273,11 @@ class JobController:
                                RestartPolicy.EXIT_CODE)
         if policy == RestartPolicy.EXIT_CODE:
             pods = self.cluster.list_pods(job.namespace, _job_selector(job))
+            # k8s convention: 128+N = killed by signal N. Local Popen reports
+            # signal deaths as negative returncodes — both are retryable.
             retryable = any(
                 p is not None and p.phase == PodPhase.FAILED
-                and (p.exit_code or 0) >= 128
+                and ((p.exit_code or 0) >= 128 or (p.exit_code or 0) < 0)
                 for p in pods
             )
         if retryable and job.status.restart_count < job.run_policy.backoff_limit:
@@ -285,6 +300,8 @@ class JobController:
         return w.restart_policy if w else RestartPolicy.NEVER
 
     def _check_deadline(self, job: JobSpec) -> None:
+        if job.status.is_finished():
+            return   # a finished (e.g. just-succeeded) job can't miss a deadline
         deadline = job.run_policy.active_deadline_seconds
         if deadline and job.status.start_time:
             if time.time() - job.status.start_time > deadline:

@@ -1,0 +1,25 @@
+"""HPO layer — Katib-equivalent hyperparameter optimization (SURVEY.md §2.3)."""
+
+from kubeflow_tpu.hpo.client import TuneClient, tune
+from kubeflow_tpu.hpo.controller import (
+    CallableTrialRunner, ExperimentController, JobTrialRunner,
+)
+from kubeflow_tpu.hpo.earlystopping import ASHA, MedianStop, make_stopper
+from kubeflow_tpu.hpo.search import ALGORITHMS, make_algorithm
+from kubeflow_tpu.hpo.service import (
+    SuggestionClient, SuggestionCore, SuggestionServer,
+)
+from kubeflow_tpu.hpo.types import (
+    AlgorithmSpec, EarlyStoppingSpec, Experiment, ObjectiveGoalType,
+    ObjectiveSpec, ParameterSpec, ParameterType, ResumePolicy, Trial,
+    TrialState,
+)
+
+__all__ = [
+    "ALGORITHMS", "ASHA", "AlgorithmSpec", "CallableTrialRunner",
+    "EarlyStoppingSpec", "Experiment", "ExperimentController",
+    "JobTrialRunner", "MedianStop", "ObjectiveGoalType", "ObjectiveSpec",
+    "ParameterSpec", "ParameterType", "ResumePolicy", "SuggestionClient",
+    "SuggestionCore", "SuggestionServer", "Trial", "TrialState", "TuneClient",
+    "make_algorithm", "make_stopper", "tune",
+]

@@ -1,0 +1,83 @@
+"""TuneClient — the KatibClient-equivalent SDK (SURVEY.md §2.3: `tune()`
+objective-fn-to-Experiment sugar, create_experiment, get results)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from kubeflow_tpu.hpo.controller import (
+    CallableTrialRunner, ExperimentController, JobTrialRunner, TrialRunner,
+)
+from kubeflow_tpu.hpo.types import (
+    AlgorithmSpec, EarlyStoppingSpec, Experiment, ObjectiveGoalType,
+    ObjectiveSpec, ParameterSpec, Trial,
+)
+
+
+def tune(
+    objective_fn: Callable,
+    parameters: Sequence[ParameterSpec],
+    *,
+    metric_name: str = "objective",
+    goal_type: str = "minimize",
+    goal: Optional[float] = None,
+    algorithm: str = "random",
+    algorithm_settings: Optional[dict] = None,
+    early_stopping: Optional[EarlyStoppingSpec] = None,
+    max_trial_count: int = 12,
+    parallel_trial_count: int = 3,
+    name: str = "tune",
+    timeout: float = 300.0,
+) -> Experiment:
+    """Run HPO over a local objective ``fn(params, report) -> float``.
+
+    The sugar path: builds the Experiment, runs trials as local callables,
+    returns the finished experiment (``.best_trial`` for the winner).
+    """
+    exp = Experiment(
+        name=name,
+        parameters=list(parameters),
+        objective=ObjectiveSpec(
+            metric_name=metric_name,
+            goal_type=ObjectiveGoalType(goal_type),
+            goal=goal,
+        ),
+        algorithm=AlgorithmSpec(name=algorithm,
+                                settings=algorithm_settings or {}),
+        early_stopping=early_stopping,
+        max_trial_count=max_trial_count,
+        parallel_trial_count=parallel_trial_count,
+    )
+    runner = CallableTrialRunner(objective_fn,
+                                 max_workers=parallel_trial_count)
+    try:
+        return ExperimentController(exp, runner).run(timeout=timeout)
+    finally:
+        runner.shutdown()
+
+
+class TuneClient:
+    """Experiment lifecycle over a TrialRunner (production: JobTrialRunner
+    over the job controller; tests: CallableTrialRunner)."""
+
+    def __init__(self, runner: TrialRunner):
+        self.runner = runner
+        self._controllers: dict[str, ExperimentController] = {}
+
+    def create_experiment(self, exp: Experiment) -> ExperimentController:
+        if exp.name in self._controllers:
+            raise KeyError(f"experiment {exp.name} already exists")
+        ctl = ExperimentController(exp, self.runner)
+        self._controllers[exp.name] = ctl
+        return ctl
+
+    def get_experiment(self, name: str) -> Optional[Experiment]:
+        ctl = self._controllers.get(name)
+        return ctl.exp if ctl else None
+
+    def wait_for_experiment(self, name: str, timeout: float = 600.0) -> Experiment:
+        return self._controllers[name].run(timeout=timeout)
+
+    def get_optimal_hyperparameters(self, name: str) -> Optional[Trial]:
+        exp = self.get_experiment(name)
+        return exp.best_trial if exp else None

@@ -1,0 +1,277 @@
+"""Experiment controller — reconciles Experiment → suggestions → Trials.
+
+Mirrors the reference's experiment/suggestion/trial controller triangle and
+its hot loop (SURVEY.md §3.2: GetSuggestions → create Trial CRs → metrics →
+goal/maxTrialCount check), with the TPU-native differences:
+
+- Trials run as JAXJobs through the job layer (JobTrialRunner) or as local
+  callables (CallableTrialRunner — the unit-test / `tune()` path).
+- Observations come from the native metrics path (MetricsWriter JSONL or a
+  direct report callback), not a stdout-scraping sidecar (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from kubeflow_tpu.api.types import JobSpec
+from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.hpo.earlystopping import make_stopper
+from kubeflow_tpu.hpo.service import SuggestionCore
+from kubeflow_tpu.hpo.types import (
+    Experiment, Observation, Trial, TrialState,
+)
+from kubeflow_tpu.training.metrics import read_metrics
+
+ReportFn = Callable[..., None]
+
+
+class TrialRunner:
+    """Launch a trial and feed observations back. Non-blocking start; the
+    controller polls ``poll`` until the trial finishes."""
+
+    def start(self, trial: Trial, experiment: Experiment) -> None:
+        raise NotImplementedError
+
+    def poll(self, trial: Trial, experiment: Experiment) -> None:
+        """Update trial.state/observations from the execution backend."""
+        raise NotImplementedError
+
+    def kill(self, trial: Trial, experiment: Experiment) -> None:
+        pass
+
+
+class CallableTrialRunner(TrialRunner):
+    """Runs ``fn(params, report)`` in a worker thread; ``report(step=, **m)``
+    streams intermediate metrics; the return value (or the last reported
+    objective metric) is the objective."""
+
+    def __init__(self, fn: Callable, max_workers: int = 8):
+        self.fn = fn
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers)
+        self._futures: dict[str, concurrent.futures.Future] = {}
+        self._stop_flags: dict[str, threading.Event] = {}
+
+    def start(self, trial, experiment):
+        stop = threading.Event()
+        self._stop_flags[trial.name] = stop
+
+        def report(step: int = 0, **metrics):
+            if stop.is_set():
+                raise _TrialStopped()
+            for k, v in metrics.items():
+                trial.observations.append(
+                    Observation(metric_name=k, value=float(v), step=step))
+
+        def run():
+            return self.fn(dict(trial.parameters), report)
+
+        self._futures[trial.name] = self._pool.submit(run)
+        trial.state = TrialState.RUNNING
+
+    def poll(self, trial, experiment):
+        fut = self._futures.get(trial.name)
+        if fut is None or not fut.done():
+            return
+        metric = experiment.objective.metric_name
+        try:
+            result = fut.result()
+        except _TrialStopped:
+            trial.state = TrialState.EARLY_STOPPED
+            finalize_objective(trial, experiment)
+            return
+        except Exception:
+            trial.state = TrialState.FAILED
+            return
+        finally:
+            trial.completion_time = time.time()
+        if result is not None:
+            trial.observations.append(
+                Observation(metric_name=metric, value=float(result)))
+        trial.state = TrialState.SUCCEEDED
+        finalize_objective(trial, experiment)
+
+    def kill(self, trial, experiment):
+        flag = self._stop_flags.get(trial.name)
+        if flag:
+            flag.set()
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class _TrialStopped(Exception):
+    pass
+
+
+def finalize_objective(trial: Trial, experiment: Experiment) -> None:
+    """Set trial.objective_value to the best intermediate value — the ONE
+    place objective semantics live for both runner kinds."""
+    vals = [v for _, v in trial.intermediate(experiment.objective.metric_name)]
+    if vals:
+        trial.objective_value = (
+            min(vals)
+            if experiment.objective.goal_type.value == "minimize"
+            else max(vals))
+
+
+class JobTrialRunner(TrialRunner):
+    """Trials are jobs in the training layer (the production path).
+
+    ``template(trial_name, params) -> JobSpec`` plays Katib's trialTemplate
+    with parameter substitution; the job's workers write metrics to
+    ``{metrics_dir}/{trial_name}.jsonl`` via training.MetricsWriter — the
+    cross-process observation contract.
+    """
+
+    def __init__(self, jobs: JobController,
+                 template: Callable[[str, dict], JobSpec],
+                 metrics_dir: str):
+        self.jobs = jobs
+        self.template = template
+        self.metrics_dir = metrics_dir
+        os.makedirs(metrics_dir, exist_ok=True)
+
+    def metrics_path(self, trial_name: str) -> str:
+        return os.path.join(self.metrics_dir, f"{trial_name}.jsonl")
+
+    def start(self, trial, experiment):
+        job = self.template(trial.name, dict(trial.parameters))
+        job.name = trial.name
+        # one namespace for submit/poll/kill: the experiment's
+        job.namespace = experiment.namespace
+        job.labels["experiment"] = experiment.name
+        for spec in job.replica_specs.values():
+            spec.template.env["KFT_METRICS_PATH"] = self.metrics_path(trial.name)
+        self.jobs.submit(job)
+        self.jobs.reconcile(job.namespace, job.name)
+        trial.state = TrialState.RUNNING
+
+    def poll(self, trial, experiment):
+        job = self.jobs.get(experiment.namespace, trial.name)
+        if job is None:
+            trial.state = TrialState.FAILED
+            return
+        self.jobs.reconcile(job.namespace, job.name)
+        self._sync_observations(trial)
+        if not job.status.is_finished():
+            return
+        trial.completion_time = time.time()
+        from kubeflow_tpu.api.types import ConditionType
+        if job.status.condition() == ConditionType.SUCCEEDED:
+            finalize_objective(trial, experiment)
+            if trial.objective_value is not None:
+                trial.state = TrialState.SUCCEEDED
+            else:
+                trial.state = TrialState.FAILED   # succeeded but no metrics
+        else:
+            trial.state = TrialState.FAILED
+
+    def kill(self, trial, experiment):
+        job = self.jobs.get(experiment.namespace, trial.name)
+        if job is not None:
+            self.jobs.delete(job.namespace, job.name)
+
+    def _sync_observations(self, trial: Trial) -> None:
+        recs = read_metrics(self.metrics_path(trial.name))
+        trial.observations = [
+            Observation(metric_name=k, value=float(v), step=int(r.get("step", 0)),
+                        timestamp=r.get("ts", 0.0))
+            for r in recs
+            for k, v in r.items()
+            if k not in ("step", "ts") and isinstance(v, (int, float))
+        ]
+
+
+class ExperimentController:
+    """Drives one experiment to completion. ``step()`` is one reconcile pass;
+    ``run()`` polls until done (the local/e2e driver, like
+    JobController.run_to_completion)."""
+
+    def __init__(self, experiment: Experiment, runner: TrialRunner,
+                 core: Optional[SuggestionCore] = None):
+        experiment.validate()
+        self.exp = experiment
+        self.runner = runner
+        self.core = core or SuggestionCore()
+        self.core.register(experiment)
+        self.stopper = make_stopper(experiment.objective,
+                                    experiment.early_stopping)
+        self._trial_seq = 0
+
+    # one reconcile pass ----------------------------------------------------
+    def step(self) -> None:
+        exp = self.exp
+        if exp.succeeded or exp.failed:
+            return
+
+        for t in exp.trials:
+            if t.state == TrialState.RUNNING:
+                self.runner.poll(t, exp)
+
+        if self.stopper is not None:
+            for t in exp.trials:
+                if t.state == TrialState.RUNNING and \
+                        self.stopper.should_stop(t, exp.trials):
+                    self.runner.kill(t, exp)
+                    self.runner.poll(t, exp)
+                    if t.state == TrialState.RUNNING:
+                        t.state = TrialState.EARLY_STOPPED
+
+        counts = exp.counts()
+        if counts[TrialState.FAILED] > exp.max_failed_trial_count:
+            exp.failed = True
+            exp.completion_reason = "MaxFailedTrialCountExceeded"
+            self._kill_running()
+            return
+        best = exp.best_trial
+        if best is not None and exp.objective.reached(best.objective_value):
+            exp.succeeded = True
+            exp.completion_reason = "GoalReached"
+            self._kill_running()
+            return
+        launched = len(exp.trials)
+        finished = sum(1 for t in exp.trials if t.is_finished())
+        if launched >= exp.max_trial_count and finished == launched:
+            exp.succeeded = best is not None
+            exp.failed = best is None
+            exp.completion_reason = "MaxTrialCountReached"
+            return
+
+        running = counts[TrialState.RUNNING] + counts[TrialState.CREATED]
+        budget = min(exp.parallel_trial_count - running,
+                     exp.max_trial_count - launched)
+        if budget > 0:
+            suggestions = self.core.get_suggestions(exp.name, budget)
+            if not suggestions and running == 0 and finished == launched:
+                # finite search space (e.g. grid) enumerated before
+                # max_trial_count: the experiment is done, not stuck
+                exp.succeeded = best is not None
+                exp.failed = best is None
+                exp.completion_reason = "SearchSpaceExhausted"
+                return
+            for assignment in suggestions:
+                self._trial_seq += 1
+                trial = Trial(name=f"{exp.name}-trial-{self._trial_seq}",
+                              parameters=assignment)
+                exp.trials.append(trial)
+                self.runner.start(trial, exp)
+
+    def run(self, timeout: float = 300.0, poll: float = 0.02) -> Experiment:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.step()
+            if self.exp.succeeded or self.exp.failed:
+                return self.exp
+            time.sleep(poll)
+        raise TimeoutError(f"experiment {self.exp.name} did not finish")
+
+    def _kill_running(self):
+        for t in self.exp.trials:
+            if t.state == TrialState.RUNNING:
+                self.runner.kill(t, self.exp)
+                t.state = TrialState.KILLED

@@ -1,0 +1,120 @@
+"""Early-stopping rules — median-stop (Katib's medianstop service,
+SURVEY.md §2.3) plus ASHA/successive-halving (the hyperband scheduler half).
+
+Both consume intermediate observations from the native metrics path and
+return a stop/continue decision per running trial; no sidecar involved.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+from kubeflow_tpu.hpo.types import (
+    EarlyStoppingSpec, ObjectiveSpec, Trial, TrialState,
+)
+
+
+class EarlyStopper:
+    def __init__(self, objective: ObjectiveSpec, spec: EarlyStoppingSpec):
+        self.objective = objective
+        self.spec = spec
+
+    def should_stop(self, trial: Trial, all_trials: Sequence[Trial]) -> bool:
+        raise NotImplementedError
+
+
+class MedianStop(EarlyStopper):
+    """Stop a trial whose best-so-far is worse than the median of other
+    trials' running averages at the same step."""
+
+    def should_stop(self, trial, all_trials):
+        metric = self.objective.metric_name
+        points = trial.intermediate(metric)
+        if not points:
+            return False
+        step = points[-1][0]
+        if step < self.spec.start_step:
+            return False
+        others = []
+        for t in all_trials:
+            if t.name == trial.name:
+                continue
+            if t.state not in (TrialState.SUCCEEDED, TrialState.RUNNING,
+                               TrialState.EARLY_STOPPED):
+                continue
+            upto = [v for s, v in t.intermediate(metric) if s <= step]
+            if upto:
+                others.append(sum(upto) / len(upto))
+        if len(others) < self.spec.min_trials_required:
+            return False
+        others.sort()
+        median = others[len(others) // 2]
+        vals = [v for _, v in points]
+        best = (min(vals) if self.objective.goal_type.value == "minimize"
+                else max(vals))
+        return not self.objective.better(best, median) and best != median
+
+
+class ASHA(EarlyStopper):
+    """Asynchronous successive halving: at each rung (min_resource * eta^k),
+    a trial survives only if it is in the top 1/eta of trials that reached
+    that rung. Random search + ASHA == hyperband-class behavior."""
+
+    def __init__(self, objective, spec):
+        super().__init__(objective, spec)
+        self.eta = float(spec.settings.get("eta", 3))
+        self.min_resource = int(spec.settings.get("min_resource", 1))
+        self.max_resource = int(spec.settings.get("max_resource", 81))
+
+    def _rungs(self):
+        r = self.min_resource
+        while r < self.max_resource:
+            yield r
+            r = int(math.ceil(r * self.eta))
+
+    def _value_at(self, t: Trial, rung: int) -> Optional[float]:
+        upto = [v for s, v in t.intermediate(self.objective.metric_name)
+                if s <= rung]
+        if not upto:
+            return None
+        return (min(upto) if self.objective.goal_type.value == "minimize"
+                else max(upto))
+
+    def should_stop(self, trial, all_trials):
+        points = trial.intermediate(self.objective.metric_name)
+        if not points:
+            return False
+        step = points[-1][0]
+        for rung in self._rungs():
+            if step < rung:
+                break
+            mine = self._value_at(trial, rung)
+            if mine is None:
+                continue
+            peers = []
+            for t in all_trials:
+                v = self._value_at(t, rung)
+                if v is not None:
+                    peers.append(v)
+            if len(peers) < max(2, int(self.eta)):
+                continue
+            sign = 1 if self.objective.goal_type.value == "minimize" else -1
+            peers.sort(key=lambda v: sign * v)
+            k = max(1, int(len(peers) / self.eta))
+            cutoff = peers[k - 1]
+            if not self.objective.better(mine, cutoff) and mine != cutoff:
+                return True
+        return False
+
+
+STOPPERS = {"medianstop": MedianStop, "asha": ASHA}
+
+
+def make_stopper(objective: ObjectiveSpec,
+                 spec: Optional[EarlyStoppingSpec]) -> Optional[EarlyStopper]:
+    if spec is None or spec.name in ("", "none"):
+        return None
+    if spec.name not in STOPPERS:
+        raise ValueError(f"unknown early stopper {spec.name!r}")
+    return STOPPERS[spec.name](objective, spec)

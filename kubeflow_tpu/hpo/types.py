@@ -1,0 +1,220 @@
+"""HPO API types — the Experiment/Suggestion/Trial surface.
+
+Capability parity with the reference's Katib CRDs (SURVEY.md §2.3:
+Experiment/Suggestion/Trial with parallelism, objective goal, max trial
+counts, early stopping, NAS out of scope for round 1), redesigned for the
+TPU stack:
+
+- Trials are JAXJobs (or local callables in tests) — the trial template is a
+  JobSpec factory with ``${param}`` substitution, mirroring Katib's
+  trialTemplate parameter substitution.
+- Metrics flow through the native metrics path (training.MetricsWriter JSONL
+  → observation log), NOT a stdout-scraping sidecar (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+import time
+from typing import Any, Optional
+
+
+class ParameterType(str, enum.Enum):
+    DOUBLE = "double"
+    INT = "int"
+    CATEGORICAL = "categorical"
+    DISCRETE = "discrete"       # ordered numeric choices
+
+
+@dataclasses.dataclass
+class ParameterSpec:
+    """Search-space dimension (Katib's feasibleSpace equivalent)."""
+
+    name: str
+    type: ParameterType = ParameterType.DOUBLE
+    min: Optional[float] = None
+    max: Optional[float] = None
+    step: Optional[float] = None
+    values: list[Any] = dataclasses.field(default_factory=list)
+    log: bool = False           # sample/model in log10 space
+
+    def validate(self) -> None:
+        if self.type in (ParameterType.DOUBLE, ParameterType.INT):
+            if self.min is None or self.max is None or self.min >= self.max:
+                raise ValueError(f"{self.name}: need min < max")
+            if self.log and self.min <= 0:
+                raise ValueError(f"{self.name}: log scale needs min > 0")
+        else:
+            if not self.values:
+                raise ValueError(f"{self.name}: need values")
+
+    # -- unit-cube mapping used by every numeric algorithm ------------------
+    def to_unit(self, value: Any) -> float:
+        if self.type == ParameterType.CATEGORICAL:
+            return self.values.index(value) / max(1, len(self.values) - 1)
+        if self.type == ParameterType.DISCRETE:
+            return self.values.index(value) / max(1, len(self.values) - 1)
+        lo, hi = float(self.min), float(self.max)
+        v = float(value)
+        if self.log:
+            lo, hi, v = math.log10(lo), math.log10(hi), math.log10(v)
+        return (v - lo) / (hi - lo)
+
+    def from_unit(self, u: float) -> Any:
+        u = min(1.0, max(0.0, u))
+        if self.type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+            idx = min(len(self.values) - 1, int(u * len(self.values)))
+            return self.values[idx]
+        lo, hi = float(self.min), float(self.max)
+        if self.log:
+            lo, hi = math.log10(lo), math.log10(hi)
+        v = lo + u * (hi - lo)
+        if self.log:
+            v = 10.0 ** v
+        if self.type == ParameterType.INT:
+            v = int(round(v))
+            if self.step:
+                v = int(self.min + round((v - self.min) / self.step) * self.step)
+            return max(int(self.min), min(int(self.max), v))
+        if self.step:
+            v = self.min + round((v - self.min) / self.step) * self.step
+        return max(float(self.min), min(float(self.max), float(v)))
+
+    def grid(self, n: int) -> list[Any]:
+        if self.type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+            return list(self.values)
+        if self.type == ParameterType.INT and (self.max - self.min) < n:
+            return list(range(int(self.min), int(self.max) + 1))
+        return [self.from_unit(i / max(1, n - 1)) for i in range(n)]
+
+
+class ObjectiveGoalType(str, enum.Enum):
+    MINIMIZE = "minimize"
+    MAXIMIZE = "maximize"
+
+
+@dataclasses.dataclass
+class ObjectiveSpec:
+    metric_name: str = "loss"
+    goal_type: ObjectiveGoalType = ObjectiveGoalType.MINIMIZE
+    goal: Optional[float] = None            # stop when reached
+    additional_metrics: list[str] = dataclasses.field(default_factory=list)
+
+    def better(self, a: float, b: float) -> bool:
+        """True if a is strictly better than b."""
+        if self.goal_type == ObjectiveGoalType.MINIMIZE:
+            return a < b
+        return a > b
+
+    def reached(self, value: float) -> bool:
+        if self.goal is None:
+            return False
+        if self.goal_type == ObjectiveGoalType.MINIMIZE:
+            return value <= self.goal
+        return value >= self.goal
+
+
+@dataclasses.dataclass
+class AlgorithmSpec:
+    name: str = "random"     # random|grid|sobol|tpe|cmaes|hyperband
+    settings: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class EarlyStoppingSpec:
+    name: str = "medianstop"     # medianstop|asha|none
+    settings: dict[str, Any] = dataclasses.field(default_factory=dict)
+    min_trials_required: int = 3
+    start_step: int = 1
+
+
+class TrialState(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    EARLY_STOPPED = "EarlyStopped"
+    KILLED = "Killed"
+
+
+@dataclasses.dataclass
+class Observation:
+    """One reported metric point — Katib's ObservationLog row."""
+
+    metric_name: str
+    value: float
+    step: int = 0
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class Trial:
+    name: str
+    parameters: dict[str, Any]
+    state: TrialState = TrialState.CREATED
+    observations: list[Observation] = dataclasses.field(default_factory=list)
+    objective_value: Optional[float] = None
+    start_time: float = dataclasses.field(default_factory=time.time)
+    completion_time: Optional[float] = None
+
+    def intermediate(self, metric: str) -> list[tuple[int, float]]:
+        return [(o.step, o.value) for o in self.observations
+                if o.metric_name == metric]
+
+    def is_finished(self) -> bool:
+        return self.state in (TrialState.SUCCEEDED, TrialState.FAILED,
+                              TrialState.EARLY_STOPPED, TrialState.KILLED)
+
+
+class ResumePolicy(str, enum.Enum):
+    NEVER = "Never"
+    LONG_RUNNING = "LongRunning"
+    FROM_VOLUME = "FromVolume"
+
+
+@dataclasses.dataclass
+class Experiment:
+    name: str
+    parameters: list[ParameterSpec]
+    objective: ObjectiveSpec = dataclasses.field(default_factory=ObjectiveSpec)
+    algorithm: AlgorithmSpec = dataclasses.field(default_factory=AlgorithmSpec)
+    early_stopping: Optional[EarlyStoppingSpec] = None
+    parallel_trial_count: int = 3
+    max_trial_count: int = 12
+    max_failed_trial_count: int = 3
+    resume_policy: ResumePolicy = ResumePolicy.NEVER
+    namespace: str = "default"
+
+    # status
+    trials: list[Trial] = dataclasses.field(default_factory=list)
+    succeeded: bool = False
+    failed: bool = False
+    completion_reason: str = ""
+
+    def validate(self) -> None:
+        if not self.parameters:
+            raise ValueError("experiment has no parameters")
+        for p in self.parameters:
+            p.validate()
+        if self.parallel_trial_count < 1:
+            raise ValueError("parallel_trial_count must be >= 1")
+
+    @property
+    def best_trial(self) -> Optional[Trial]:
+        best = None
+        for t in self.trials:
+            if t.state != TrialState.SUCCEEDED or t.objective_value is None:
+                continue
+            if best is None or self.objective.better(
+                t.objective_value, best.objective_value
+            ):
+                best = t
+        return best
+
+    def counts(self) -> dict[TrialState, int]:
+        out = {s: 0 for s in TrialState}
+        for t in self.trials:
+            out[t.state] += 1
+        return out

@@ -1,0 +1,266 @@
+"""HPO layer tests — mirrors the reference's Katib test strategy
+(SURVEY.md §4: algorithm unit tests + one e2e experiment per algorithm,
+run here as local-callable trials instead of kind jobs)."""
+
+import math
+
+import pytest
+
+from kubeflow_tpu.api.types import jax_job
+from kubeflow_tpu.controller.cluster import FakeCluster, PodPhase
+from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.hpo import (
+    ASHA, AlgorithmSpec, CallableTrialRunner, EarlyStoppingSpec, Experiment,
+    ExperimentController, JobTrialRunner, MedianStop, ObjectiveSpec,
+    ParameterSpec, ParameterType, SuggestionCore, SuggestionServer,
+    SuggestionClient, Trial, TrialState, make_algorithm, tune,
+)
+from kubeflow_tpu.hpo.types import ObjectiveGoalType
+
+
+def quadratic_params():
+    return [
+        ParameterSpec(name="x", type=ParameterType.DOUBLE, min=-2.0, max=2.0),
+        ParameterSpec(name="y", type=ParameterType.DOUBLE, min=-2.0, max=2.0),
+    ]
+
+
+def sphere(params, report):
+    v = (params["x"] - 0.5) ** 2 + (params["y"] + 0.25) ** 2
+    report(step=1, objective=v)
+    return v
+
+
+# ---------------------------------------------------------------- parameters
+
+def test_parameter_unit_roundtrip():
+    p = ParameterSpec(name="lr", min=1e-5, max=1e-1, log=True)
+    for v in (1e-5, 1e-3, 1e-1):
+        assert math.isclose(p.from_unit(p.to_unit(v)), v, rel_tol=1e-9)
+    pi = ParameterSpec(name="n", type=ParameterType.INT, min=2, max=64)
+    assert pi.from_unit(0.0) == 2 and pi.from_unit(1.0) == 64
+    pc = ParameterSpec(name="opt", type=ParameterType.CATEGORICAL,
+                       values=["adam", "sgd", "lion"])
+    assert pc.from_unit(pc.to_unit("sgd")) == "sgd"
+
+
+def test_parameter_validation():
+    with pytest.raises(ValueError):
+        ParameterSpec(name="bad", min=1.0, max=0.5).validate()
+    with pytest.raises(ValueError):
+        ParameterSpec(name="bad", min=-1.0, max=1.0, log=True).validate()
+
+
+# ---------------------------------------------------------------- algorithms
+
+@pytest.mark.parametrize("algo", ["random", "sobol", "tpe", "cmaes"])
+def test_algorithm_suggests_in_bounds(algo):
+    exp = Experiment(name=f"e-{algo}", parameters=quadratic_params(),
+                     algorithm=AlgorithmSpec(name=algo))
+    a = make_algorithm(exp)
+    for assignment in a.suggest([], 8):
+        assert -2.0 <= assignment["x"] <= 2.0
+        assert -2.0 <= assignment["y"] <= 2.0
+
+
+def test_grid_enumerates_exactly():
+    params = [
+        ParameterSpec(name="a", type=ParameterType.CATEGORICAL, values=[1, 2]),
+        ParameterSpec(name="b", type=ParameterType.DOUBLE, min=0, max=1),
+    ]
+    exp = Experiment(name="g", parameters=params,
+                     algorithm=AlgorithmSpec(name="grid",
+                                             settings={"points_per_dim": 3}))
+    a = make_algorithm(exp)
+    got = a.suggest([], 100)
+    assert len(got) == 6           # 2 * 3
+    assert a.suggest([], 10) == [] # exhausted
+
+
+def _fake_history(algo_exp, points):
+    trials = []
+    for i, (x, y, v) in enumerate(points):
+        t = Trial(name=f"t{i}", parameters={"x": x, "y": y})
+        t.state = TrialState.SUCCEEDED
+        t.objective_value = v
+        trials.append(t)
+    return trials
+
+
+def test_tpe_exploits_good_region():
+    exp = Experiment(
+        name="tpe", parameters=quadratic_params(),
+        algorithm=AlgorithmSpec(name="tpe", settings={"n_startup_trials": 4}))
+    a = make_algorithm(exp)
+    # history: points near (0.5, -0.25) are good
+    pts = []
+    for i in range(20):
+        x = -2 + 4 * (i / 19)
+        y = 2 - 4 * (i / 19)
+        pts.append((x, y, (x - 0.5) ** 2 + (y + 0.25) ** 2))
+    sugg = a.suggest(_fake_history(exp, pts), 16)
+    mean_x = sum(s["x"] for s in sugg) / len(sugg)
+    # Biased toward the optimum, not uniform over [-2, 2]
+    assert -0.5 < mean_x < 1.5
+
+
+def test_cmaes_rejects_categorical():
+    params = [ParameterSpec(name="c", type=ParameterType.CATEGORICAL,
+                            values=["a", "b"])]
+    exp = Experiment(name="c", parameters=params,
+                     algorithm=AlgorithmSpec(name="cmaes"))
+    with pytest.raises(ValueError):
+        make_algorithm(exp)
+
+
+# ------------------------------------------------------------ early stopping
+
+def _trial_with(metric, points, name="t", state=TrialState.RUNNING):
+    t = Trial(name=name, parameters={})
+    t.state = state
+    for step, v in points:
+        from kubeflow_tpu.hpo.types import Observation
+        t.observations.append(Observation(metric_name=metric, value=v, step=step))
+    return t
+
+
+def test_median_stop():
+    obj = ObjectiveSpec(metric_name="loss", goal_type=ObjectiveGoalType.MINIMIZE)
+    spec = EarlyStoppingSpec(name="medianstop", min_trials_required=3)
+    stopper = MedianStop(obj, spec)
+    good = [_trial_with("loss", [(1, 0.5), (2, 0.3)], name=f"g{i}",
+                        state=TrialState.SUCCEEDED) for i in range(3)]
+    bad = _trial_with("loss", [(1, 2.0), (2, 1.9)], name="bad")
+    assert stopper.should_stop(bad, good + [bad])
+    promising = _trial_with("loss", [(1, 0.2)], name="prom")
+    assert not stopper.should_stop(promising, good + [promising])
+
+
+def test_asha_drops_bottom():
+    obj = ObjectiveSpec(metric_name="loss")
+    spec = EarlyStoppingSpec(
+        name="asha", settings={"eta": 2, "min_resource": 1, "max_resource": 8})
+    stopper = ASHA(obj, spec)
+    trials = [_trial_with("loss", [(1, v)], name=f"t{i}")
+              for i, v in enumerate([0.1, 0.2, 0.4, 0.9])]
+    assert stopper.should_stop(trials[-1], trials)       # worst at rung 1
+    assert not stopper.should_stop(trials[0], trials)    # best survives
+
+
+# ---------------------------------------------------------------- controller
+
+def test_tune_quadratic_converges():
+    exp = tune(
+        sphere, quadratic_params(), metric_name="objective",
+        algorithm="tpe", max_trial_count=30, parallel_trial_count=4,
+        name="sphere", timeout=120.0,
+    )
+    assert exp.succeeded
+    best = exp.best_trial
+    assert best is not None and best.objective_value < 0.5
+
+
+def test_grid_exhaustion_completes_experiment():
+    """A finite grid smaller than max_trial_count must finish, not hang."""
+    params = [ParameterSpec(name="a", type=ParameterType.CATEGORICAL,
+                            values=[0.0, 1.0, 2.0])]
+
+    def obj(p, report):
+        return float(p["a"])
+
+    exp = tune(obj, params, algorithm="grid", max_trial_count=12,
+               parallel_trial_count=2, name="gridx", timeout=60.0)
+    assert exp.succeeded
+    assert exp.completion_reason == "SearchSpaceExhausted"
+    assert len(exp.trials) == 3
+    assert exp.best_trial.objective_value == 0.0
+
+
+def test_goal_short_circuits():
+    calls = []
+
+    def obj(params, report):
+        calls.append(1)
+        return 0.0   # instantly optimal
+
+    exp = tune(obj, quadratic_params(), goal=0.5, max_trial_count=50,
+               parallel_trial_count=1, name="goal", timeout=60.0)
+    assert exp.succeeded and exp.completion_reason == "GoalReached"
+    assert len(calls) < 50
+
+
+def test_failed_trials_bound():
+    def obj(params, report):
+        raise RuntimeError("boom")
+
+    exp = Experiment(name="fail", parameters=quadratic_params(),
+                     max_trial_count=50, max_failed_trial_count=2,
+                     parallel_trial_count=1)
+    runner = CallableTrialRunner(obj, max_workers=1)
+    ctl = ExperimentController(exp, runner)
+    result = ctl.run(timeout=60.0)
+    assert result.failed
+    assert result.completion_reason == "MaxFailedTrialCountExceeded"
+    runner.shutdown()
+
+
+# ------------------------------------------------------------ job-backed HPO
+
+def test_job_trial_runner_with_fake_cluster(tmp_path):
+    """Trial = JAXJob on a FakeCluster; metrics arrive via the JSONL contract
+    (the envtest-style test: pods never run, phases driven by hand)."""
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+
+    def template(trial_name, params):
+        return jax_job(trial_name, workers=1,
+                       env={"LR": str(params["x"])})
+
+    runner = JobTrialRunner(jobs, template, metrics_dir=str(tmp_path))
+    exp = Experiment(
+        name="jobexp", parameters=quadratic_params(),
+        objective=ObjectiveSpec(metric_name="loss"),
+        max_trial_count=3, parallel_trial_count=1, max_failed_trial_count=0,
+    )
+    ctl = ExperimentController(exp, runner)
+
+    import json
+    for _ in range(40):
+        ctl.step()
+        if exp.succeeded or exp.failed:
+            break
+        # drive every running trial's pod to success, writing its metric
+        for t in exp.trials:
+            if t.state != TrialState.RUNNING:
+                continue
+            job = jobs.get("default", t.name)
+            jobs.reconcile("default", t.name)
+            x = float(job.replica_specs["Worker"].template.env["LR"])
+            path = runner.metrics_path(t.name)
+            with open(path, "w") as f:
+                f.write(json.dumps({"step": 1, "loss": (x - 0.5) ** 2}) + "\n")
+            for (ns, name), pod in list(cluster.pods.items()):
+                if pod.labels.get("job-name") == t.name:
+                    cluster.set_phase(ns, name, PodPhase.SUCCEEDED)
+    assert exp.succeeded
+    assert len(exp.trials) == 3
+    assert exp.best_trial.objective_value >= 0.0
+
+
+# ---------------------------------------------------------------- service
+
+def test_suggestion_server_roundtrip():
+    core = SuggestionCore()
+    exp = Experiment(name="svc", parameters=quadratic_params())
+    core.register(exp)
+    server = SuggestionServer(core).start()
+    try:
+        client = SuggestionClient(server.address)
+        sugg = client.get_suggestions("svc", 3)
+        assert len(sugg) == 3 and all("x" in s for s in sugg)
+        client.report_observation("svc-trial-1", "loss", 0.42, step=7)
+        obs = client.get_observations("svc-trial-1")
+        assert obs == [{"metric": "loss", "value": 0.42, "step": 7}]
+        client.close()
+    finally:
+        server.stop()
