@@ -217,10 +217,12 @@ class ExperimentController:
             for t in exp.trials:
                 if t.state == TrialState.RUNNING and \
                         self.stopper.should_stop(t, exp.trials):
+                    # settle the state FIRST: polling after kill would see a
+                    # deleted job and misreport the trial as FAILED
+                    finalize_objective(t, exp)
+                    t.state = TrialState.EARLY_STOPPED
+                    t.completion_time = time.time()
                     self.runner.kill(t, exp)
-                    self.runner.poll(t, exp)
-                    if t.state == TrialState.RUNNING:
-                        t.state = TrialState.EARLY_STOPPED
 
         counts = exp.counts()
         if counts[TrialState.FAILED] > exp.max_failed_trial_count:

@@ -177,8 +177,8 @@ class CMAESSearch(SearchAlgorithm):
         self.ps = np.zeros(d)
         self.C = np.eye(d)
         self.chiN = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d ** 2))
-        self._pending: list[tuple[Assignment, np.ndarray]] = []
-        self._gen_seen = 0
+        self._consumed: set[str] = set()   # trial names already used
+        self._generation = 0
 
     def suggest(self, trials, count):
         self._maybe_update(trials)
@@ -196,12 +196,14 @@ class CMAESSearch(SearchAlgorithm):
         return out
 
     def _maybe_update(self, trials):
-        done = _completed(trials)
-        new = done[self._gen_seen:]
+        # trials complete out of creation order under parallelism: track
+        # consumption by name, not by index
+        new = [t for t in _completed(trials) if t.name not in self._consumed]
         if len(new) < self.lam:
             return
         batch = new[:self.lam]
-        self._gen_seen += self.lam
+        self._consumed.update(t.name for t in batch)
+        self._generation += 1
         sign = 1.0 if self.objective.goal_type.value == "minimize" else -1.0
         batch = sorted(batch, key=lambda t: sign * t.objective_value)[:self.mu]
         xs = np.stack([self._to_units(t.parameters) for t in batch])
@@ -216,7 +218,7 @@ class CMAESSearch(SearchAlgorithm):
         self.ps = (1 - self.cs) * self.ps + math.sqrt(
             self.cs * (2 - self.cs) * self.mueff) * (invsqrtC @ y)
         hsig = (np.linalg.norm(self.ps)
-                / math.sqrt(1 - (1 - self.cs) ** (2 * (self._gen_seen // self.lam)))
+                / math.sqrt(1 - (1 - self.cs) ** (2 * self._generation))
                 / self.chiN) < 1.4 + 2 / (self.d + 1)
         self.pc = (1 - self.cc) * self.pc + hsig * math.sqrt(
             self.cc * (2 - self.cc) * self.mueff) * y

@@ -236,14 +236,19 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
     }
 
 
-def prefill(params, tokens, cfg: LlamaConfig, cache):
+def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
     """Run the prompt through the model, filling the cache.
 
-    tokens: [B,S]. Returns (logits_last [B,V], cache). Assumes left-aligned
-    prompts of equal length S (the batcher pads; per-seq lengths tracked in
-    cache["len"]).
+    tokens: [B,S] left-aligned, right-padded. ``lengths`` ([B] int32, default
+    S) gives each prompt's true length: logits are read at position
+    ``lengths-1`` and ``cache["len"]`` is set per sequence, so the
+    continuous-batching engine can prefill padded buckets. Pad rows beyond a
+    sequence's length hold garbage KV but are never attended (decode masks to
+    cache len and overwrites them one position at a time).
     """
     b, s = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
     positions = jnp.arange(s)[None, :]
     inv_freq = jnp.asarray(rope_frequencies(
         cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
@@ -280,9 +285,11 @@ def prefill(params, tokens, cfg: LlamaConfig, cache):
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, -1], head.astype(cfg.dtype))
-    cache = {"k": new_k, "v": new_v,
-             "len": jnp.full((b,), s, jnp.int32)}
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = jnp.einsum("bd,dv->bv", last, head.astype(cfg.dtype))
+    cache = {"k": new_k, "v": new_v, "len": lengths.astype(jnp.int32)}
     return logits.astype(jnp.float32), cache
 
 

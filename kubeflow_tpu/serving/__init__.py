@@ -1,0 +1,34 @@
+"""Serving layer — KServe-equivalent model serving (SURVEY.md §2.4)."""
+
+from kubeflow_tpu.serving.controller import (
+    Autoscaler, RuntimeRegistry, ServingController,
+)
+from kubeflow_tpu.serving.jax_model import (
+    JAXModel, LLMModel, enable_compile_cache,
+)
+from kubeflow_tpu.serving.llm import GenRequest, LLMEngine, SamplingParams
+from kubeflow_tpu.serving.model import (
+    Model, ModelMissing, ModelNotReady, ModelRepository,
+)
+from kubeflow_tpu.serving.protocol import (
+    InferRequest, InferResponse, InferTensor,
+)
+from kubeflow_tpu.serving.router import GraphRouter, TrafficSplitter
+from kubeflow_tpu.serving.server import InferenceClient, ModelServer
+from kubeflow_tpu.serving.storage import download
+from kubeflow_tpu.serving.types import (
+    ComponentSpec, GraphNode, GraphNodeType, GraphStep, InferenceGraph,
+    InferenceService, ModelFormat, PredictorSpec, ServingRuntime,
+    TrainedModel,
+)
+
+__all__ = [
+    "Autoscaler", "ComponentSpec", "GenRequest", "GraphNode", "GraphNodeType",
+    "GraphRouter", "GraphStep", "InferRequest", "InferResponse",
+    "InferTensor", "InferenceClient", "InferenceGraph", "InferenceService",
+    "JAXModel", "LLMEngine", "LLMModel", "Model", "ModelFormat",
+    "ModelMissing", "ModelNotReady", "ModelRepository", "ModelServer",
+    "PredictorSpec", "RuntimeRegistry", "SamplingParams", "ServingController",
+    "ServingRuntime", "TrafficSplitter", "TrainedModel", "download",
+    "enable_compile_cache",
+]

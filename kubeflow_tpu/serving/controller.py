@@ -1,0 +1,245 @@
+"""InferenceService controller + runtime selection + canary rollout.
+
+Parity: SURVEY.md §2.4 'InferenceService controller' and §3.3 — reconcile
+predictor/transformer/explainer into runtime pods (the raw-Deployment mode;
+serverless scale-to-zero arrives with the autoscaler), select a
+ServingRuntime by model format, track revisions, and split traffic between
+the previous ready revision and the canary revision.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from kubeflow_tpu.controller.cluster import Cluster, Pod, PodPhase, Service
+from kubeflow_tpu.serving.types import (
+    InferenceService, ModelFormat, ServingRuntime,
+)
+
+
+class RuntimeRegistry:
+    """ServingRuntime store with the reference's matching rule: namespace
+    runtimes beat cluster runtimes, then priority, then name."""
+
+    def __init__(self):
+        self._runtimes: dict[tuple[Optional[str], str], ServingRuntime] = {}
+
+    def register(self, rt: ServingRuntime) -> None:
+        self._runtimes[(rt.namespace, rt.name)] = rt
+
+    def get(self, name: str, namespace: Optional[str] = None
+            ) -> Optional[ServingRuntime]:
+        return (self._runtimes.get((namespace, name))
+                or self._runtimes.get((None, name)))
+
+    def select(self, fmt: ModelFormat, namespace: str
+               ) -> Optional[ServingRuntime]:
+        candidates = [
+            rt for rt in self._runtimes.values()
+            if rt.supports(fmt) and rt.namespace in (None, namespace)
+        ]
+        if not candidates:
+            return None
+        candidates.sort(
+            key=lambda rt: (rt.namespace is None, -rt.priority, rt.name))
+        return candidates[0]
+
+
+def _pod_name(isvc: InferenceService, component: str, revision: int,
+              index: int) -> str:
+    return f"{isvc.name}-{component}-rev{revision}-{index}"
+
+
+class ServingController:
+    """Reconciles InferenceServices against a Cluster.
+
+    Revisions: every spec change (generation bump) creates a new revision's
+    pods; once the new revision is ready, traffic moves — fully, or split by
+    canary_traffic_percent, with the old revision kept for rollback. The
+    reference gets this from Knative; here it is explicit and testable.
+    """
+
+    def __init__(self, cluster: Cluster, runtimes: RuntimeRegistry):
+        self.cluster = cluster
+        self.runtimes = runtimes
+        self.services: dict[tuple[str, str], InferenceService] = {}
+        self._applied_generation: dict[tuple[str, str], int] = {}
+
+    # -------------- apiserver-ish surface --------------
+
+    def apply(self, isvc: InferenceService) -> InferenceService:
+        key = (isvc.namespace, isvc.name)
+        existing = self.services.get(key)
+        if existing is None:
+            isvc.generation = 1
+            self.services[key] = isvc
+        else:
+            isvc.generation = existing.generation + 1
+            isvc.status = existing.status
+            self.services[key] = isvc
+        self.reconcile(isvc.namespace, isvc.name)
+        return isvc
+
+    def get(self, namespace: str, name: str) -> Optional[InferenceService]:
+        return self.services.get((namespace, name))
+
+    def delete(self, namespace: str, name: str) -> None:
+        isvc = self.services.pop((namespace, name), None)
+        if isvc is None:
+            return
+        for pod in self._pods(isvc):
+            self.cluster.delete_pod(namespace, pod.name)
+        self.cluster.delete_service(namespace, isvc.name)
+
+    # -------------- reconcile --------------
+
+    def reconcile(self, namespace: str, name: str
+                  ) -> Optional[InferenceService]:
+        isvc = self.services.get((namespace, name))
+        if isvc is None:
+            return None
+        key = (namespace, name)
+
+        runtime = self._select_runtime(isvc)
+        if runtime is None:
+            msg = (f"NoRuntime: no ServingRuntime supports format "
+                   f"{isvc.predictor.model_format.name!r}")
+            if not isvc.status.conditions or isvc.status.conditions[-1] != msg:
+                isvc.status.conditions.append(msg)
+            return isvc
+
+        if self._applied_generation.get(key) != isvc.generation:
+            isvc.status.latest_revision += 1
+            self._applied_generation[key] = isvc.generation
+            self._create_revision_pods(isvc, runtime,
+                                       isvc.status.latest_revision)
+
+        if self.cluster.get_service(namespace, isvc.name) is None:
+            self.cluster.create_service(Service(
+                name=isvc.name, namespace=namespace,
+                selector={"isvc": isvc.name}, port=8080))
+
+        latest = isvc.status.latest_revision
+        if self._revision_ready(isvc, latest):
+            prev = isvc.status.ready_revision
+            canary = isvc.predictor.canary_traffic_percent
+            if prev and prev != latest and canary is not None and canary < 100:
+                isvc.status.traffic = {latest: canary, prev: 100 - canary}
+            else:
+                isvc.status.traffic = {latest: 100}
+                self._gc_old_revisions(isvc, keep=latest)
+                isvc.status.ready_revision = latest
+            isvc.status.ready = True
+            isvc.status.url = self.cluster.resolve(namespace, isvc.name)
+        elif isvc.status.ready_revision:
+            # latest not ready yet: all traffic stays on the ready revision
+            isvc.status.traffic = {isvc.status.ready_revision: 100}
+        return isvc
+
+    def promote(self, namespace: str, name: str) -> None:
+        """Finish a canary rollout: 100% to latest, GC the old revision."""
+        isvc = self.services[(namespace, name)]
+        isvc.predictor.canary_traffic_percent = None
+        self.reconcile(namespace, name)
+
+    def rollback(self, namespace: str, name: str) -> None:
+        """Abort a canary: all traffic back to the ready revision and drop
+        the canary pods."""
+        isvc = self.services[(namespace, name)]
+        latest = isvc.status.latest_revision
+        prev = isvc.status.ready_revision
+        if not prev or prev == latest:
+            return
+        for pod in self._pods(isvc, revision=latest):
+            self.cluster.delete_pod(namespace, pod.name)
+        isvc.status.latest_revision = prev
+        isvc.status.traffic = {prev: 100}
+        isvc.predictor.canary_traffic_percent = None
+
+    # -------------- internals --------------
+
+    def _select_runtime(self, isvc: InferenceService
+                        ) -> Optional[ServingRuntime]:
+        if isvc.predictor.runtime:
+            return self.runtimes.get(isvc.predictor.runtime, isvc.namespace)
+        return self.runtimes.select(isvc.predictor.model_format,
+                                    isvc.namespace)
+
+    def _create_revision_pods(self, isvc: InferenceService,
+                              runtime: ServingRuntime, revision: int) -> None:
+        components: list[tuple[str, int, dict]] = [
+            ("predictor", isvc.predictor.min_replicas, {
+                **runtime.env, **isvc.predictor.env,
+                "KFT_MODEL_FORMAT": isvc.predictor.model_format.name,
+                "KFT_STORAGE_URI": isvc.predictor.storage_uri or "",
+                "KFT_COMPILE_CACHE": runtime.compile_cache_dir or "",
+            }),
+        ]
+        if isvc.transformer:
+            components.append(
+                ("transformer", isvc.transformer.min_replicas,
+                 dict(isvc.transformer.env)))
+        if isvc.explainer:
+            components.append(
+                ("explainer", isvc.explainer.min_replicas,
+                 dict(isvc.explainer.env)))
+        for comp, replicas, env in components:
+            for i in range(replicas):
+                pname = _pod_name(isvc, comp, revision, i)
+                if self.cluster.get_pod(isvc.namespace, pname) is None:
+                    self.cluster.create_pod(Pod(
+                        name=pname, namespace=isvc.namespace,
+                        labels={"isvc": isvc.name, "component": comp,
+                                "revision": str(revision)},
+                        env=env, command=list(runtime.command)))
+
+    def _pods(self, isvc: InferenceService,
+              revision: Optional[int] = None) -> list[Pod]:
+        sel = {"isvc": isvc.name}
+        if revision is not None:
+            sel["revision"] = str(revision)
+        return [p for p in self.cluster.list_pods(isvc.namespace, sel)
+                if p is not None]
+
+    def _revision_ready(self, isvc: InferenceService, revision: int) -> bool:
+        pods = self._pods(isvc, revision)
+        want = isvc.predictor.min_replicas
+        if isvc.transformer:
+            want += isvc.transformer.min_replicas
+        if isvc.explainer:
+            want += isvc.explainer.min_replicas
+        running = sum(1 for p in pods if p.phase == PodPhase.RUNNING)
+        return running >= want
+
+    def _gc_old_revisions(self, isvc: InferenceService, keep: int) -> None:
+        for pod in self._pods(isvc):
+            if pod.labels.get("revision") != str(keep):
+                self.cluster.delete_pod(isvc.namespace, pod.name)
+
+
+class Autoscaler:
+    """Concurrency-driven replica scaling for the raw-deployment mode (the
+    reference's HPA/KPA role). ``observe`` feeds it per-service concurrency;
+    ``scale`` returns the desired replica count clamped to min/max, with
+    scale-to-zero when min_replicas == 0 and the service has been idle past
+    the grace period."""
+
+    def __init__(self, idle_grace_seconds: float = 30.0):
+        self.idle_grace = idle_grace_seconds
+        self._last_busy: dict[tuple[str, str], float] = {}
+
+    def scale(self, isvc: InferenceService, concurrency: float,
+              now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        key = (isvc.namespace, isvc.name)
+        p = isvc.predictor
+        if concurrency > 0:
+            self._last_busy[key] = now
+        desired = int(-(-concurrency // max(1, p.scale_target)))  # ceil
+        if p.min_replicas == 0:
+            idle_since = self._last_busy.get(key, 0.0)
+            if concurrency == 0 and now - idle_since > self.idle_grace:
+                return 0
+            desired = max(1, desired)
+        return max(p.min_replicas, min(p.max_replicas, desired))

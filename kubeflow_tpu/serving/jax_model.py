@@ -1,0 +1,197 @@
+"""JAX predictor runtimes — the TPU-native ServingRuntime contents.
+
+The reference's sklearn/xgboost/huggingface servers become two runtimes
+(SURVEY.md §2.4, BASELINE.md Llama-3-8B InferenceService config):
+
+- ``JAXModel``: any jittable fn(params, batch) -> outputs, with padded batch
+  buckets (bounded compile variants) and a persistent XLA compile cache so
+  cold start is a cache load, not a compile (SURVEY.md §7 hard part #4).
+- ``LLMModel``: Llama generate endpoint over the continuous-batching
+  LLMEngine, driven by a background scheduler thread so concurrent HTTP
+  requests share one decode batch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+from kubeflow_tpu.serving.model import Model
+from kubeflow_tpu.serving.protocol import InferRequest, InferResponse
+
+
+def enable_compile_cache(cache_dir: str) -> None:
+    """Persistent XLA compile cache: serving cold start becomes a cache read
+    (minutes -> seconds). Safe to call more than once."""
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def _next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class JAXModel(Model):
+    """Serves ``fn(params, inputs) -> outputs`` under jit with batch-size
+    bucketing: requests are padded up to the nearest bucket so XLA compiles
+    a handful of shapes, never one per request size."""
+
+    def __init__(self, name: str, fn: Callable, params=None, *,
+                 batch_buckets: Sequence[int] = (1, 4, 16, 64),
+                 compile_cache_dir: Optional[str] = None,
+                 warmup: bool = True,
+                 example_shape: Optional[Sequence[int]] = None):
+        super().__init__(name)
+        self.fn = fn
+        self.params = params
+        self.buckets = sorted(batch_buckets)
+        self.compile_cache_dir = compile_cache_dir
+        self.warmup = warmup
+        self.example_shape = tuple(example_shape) if example_shape else None
+        self._jitted = None
+
+    def load(self) -> bool:
+        if self.compile_cache_dir:
+            enable_compile_cache(self.compile_cache_dir)
+        self._jitted = jax.jit(self.fn)
+        if self.warmup and self.example_shape is not None:
+            for b in self.buckets:
+                x = np.zeros((b, *self.example_shape), np.float32)
+                jax.block_until_ready(self._jitted(self.params, x))
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self._jitted = None
+        self.ready = False
+
+    def predict(self, request: InferRequest) -> InferResponse:
+        x = request.as_numpy()
+        n = x.shape[0]
+        # batches beyond the largest bucket run in largest-bucket chunks, so
+        # the set of compiled shapes stays bounded no matter the request size
+        top = self.buckets[-1]
+        chunks = []
+        for start in range(0, n, top):
+            part = x[start:start + top]
+            m = part.shape[0]
+            bucket = _next_bucket(m, self.buckets)
+            if bucket > m:
+                pad = np.zeros((bucket - m, *part.shape[1:]), part.dtype)
+                part = np.concatenate([part, pad], axis=0)
+            chunks.append(np.asarray(self._jitted(self.params, part))[:m])
+        out = np.concatenate(chunks, axis=0)
+        return InferResponse.from_numpy(self.name, {"output-0": out},
+                                        id=request.id)
+
+
+class LLMModel(Model):
+    """Generate endpoint over the continuous-batching engine.
+
+    Request contract (V2): INT32/INT64 input tensor of token ids [B, S]
+    (right-padded with pad_id) or a single sequence [S]; parameters:
+    max_tokens, temperature, top_k, top_p, eos_id. Response: "tokens"
+    [B, max_new] (right-padded with pad_id) + "lengths" [B].
+
+    All concurrent HTTP handlers enqueue into ONE engine; a background
+    scheduler thread steps the engine while work exists, so simultaneous
+    requests batch onto the MXU together (continuous batching).
+    """
+
+    def __init__(self, name: str, params, cfg, *, max_batch: int = 8,
+                 max_seq: int = 1024, pad_id: int = 0,
+                 compile_cache_dir: Optional[str] = None,
+                 prefill_buckets: Sequence[int] = (64, 128, 256, 512)):
+        super().__init__(name)
+        self._params = params
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.pad_id = pad_id
+        self.compile_cache_dir = compile_cache_dir
+        self.prefill_buckets = prefill_buckets
+        self.engine: Optional[LLMEngine] = None
+        self._wake = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown = False
+
+    def load(self) -> bool:
+        if self.compile_cache_dir:
+            enable_compile_cache(self.compile_cache_dir)
+        self.engine = LLMEngine(
+            self._params, self.cfg, max_batch=self.max_batch,
+            max_seq=self.max_seq,
+            prefill_buckets=[b for b in self.prefill_buckets
+                             if b <= self.max_seq] or [self.max_seq])
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self._shutdown = True
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.engine = None
+        self.ready = False
+
+    def _loop(self) -> None:
+        while not self._shutdown:
+            with self._wake:
+                while not self._shutdown and not self.engine.has_work():
+                    self._wake.wait(timeout=0.1)
+            if self._shutdown:
+                return
+            self.engine.step()
+            # requests can also finish inside admit (instant EOS / 1-token
+            # budget), so wake waiters after every step unconditionally
+            with self._wake:
+                self._wake.notify_all()
+
+    def predict(self, request: InferRequest) -> InferResponse:
+        ids = request.as_numpy()
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        p = request.parameters
+        sampling = SamplingParams(
+            max_tokens=int(p.get("max_tokens", 64)),
+            temperature=float(p.get("temperature", 0.0)),
+            top_k=int(p.get("top_k", 0)),
+            top_p=float(p.get("top_p", 1.0)),
+            eos_id=(int(p["eos_id"]) if "eos_id" in p else None),
+        )
+        reqs = []
+        with self._wake:
+            for row in ids:
+                prompt = [int(t) for t in row]
+                # strip only TRAILING padding — pad_id may be a real token
+                # elsewhere in the sequence
+                while prompt and prompt[-1] == self.pad_id:
+                    prompt.pop()
+                reqs.append(self.engine.add_request(prompt, sampling))
+            self._wake.notify_all()
+        with self._wake:
+            self._wake.wait_for(lambda: all(r.done for r in reqs)
+                                or self._shutdown, timeout=600)
+        if not all(r.done for r in reqs):
+            raise TimeoutError("generation did not finish")
+        max_new = max(len(r.generated) for r in reqs)
+        tokens = np.full((len(reqs), max_new), self.pad_id, np.int32)
+        lengths = np.zeros((len(reqs),), np.int32)
+        for i, r in enumerate(reqs):
+            tokens[i, :len(r.generated)] = r.generated
+            lengths[i] = len(r.generated)
+        return InferResponse.from_numpy(
+            self.name, {"tokens": tokens, "lengths": lengths}, id=request.id)

@@ -1,0 +1,252 @@
+"""LLM serving engine — continuous batching over the Llama decode path.
+
+This is the TPU-native answer to the reference's huggingfaceserver/vLLM
+runtime (SURVEY.md §2.4 'Runtime servers': LLM generate endpoints): a
+slot-based continuous-batching engine where
+
+- the KV cache is ONE static-shape arena [layers, max_batch, max_seq, ...]
+  (XLA-friendly: no dynamic shapes, ever);
+- prompts prefill into padded length buckets (few compile variants), and
+  their KV rows are inserted into free slots with dynamic_update_slice;
+- every step runs ONE jitted decode+sample over all slots — requests join
+  and leave between steps without recompiling (the continuous-batching
+  property that keeps the MXU fed at high request churn);
+- sampling (greedy/temperature/top-k/top-p) runs on-device in the same
+  program, so only sampled token ids cross back to the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import llama
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0          # 0 = greedy
+    top_k: int = 0                    # 0 = off
+    top_p: float = 1.0                # 1 = off
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class GenRequest:
+    id: int
+    prompt: list[int]
+    sampling: SamplingParams
+    generated: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    slot: Optional[int] = None
+
+    @property
+    def finish_reason(self) -> str:
+        if self.sampling.eos_id is not None and self.generated and \
+                self.generated[-1] == self.sampling.eos_id:
+            return "stop"
+        return "length"
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
+
+
+def sample_logits(logits, rng, temperature, top_k, top_p):
+    """On-device sampling: greedy when temperature==0, else
+    temperature/top-k/top-p. temperature/top_k/top_p are per-batch arrays
+    ([B]); top_k==0 / top_p==1 disable the respective filter."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    sorted_asc = jnp.sort(scaled, axis=-1)               # [B, V] ascending
+    # top-k: kth-largest value per row; rows with top_k==0 keep everything
+    k_idx = jnp.clip(vocab - top_k, 0, vocab - 1)
+    kth = jnp.take_along_axis(sorted_asc, k_idx[:, None], axis=-1)
+    kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p (nucleus): drop the tail whose cumulative prob exceeds p
+    sorted_desc = sorted_asc[:, ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.minimum(
+        jnp.sum(cum < top_p[:, None], axis=-1), vocab - 1)
+    cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+class LLMEngine:
+    """Continuous-batching generation over llama prefill/decode_step."""
+
+    def __init__(self, params, cfg: llama.LlamaConfig, *,
+                 max_batch: int = 8, max_seq: int = 1024,
+                 prefill_buckets: Sequence[int] = (64, 128, 256, 512)):
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.buckets = sorted(b for b in prefill_buckets if b <= max_seq)
+        if not self.buckets:
+            raise ValueError("no prefill bucket fits max_seq")
+        self.cache = llama.init_cache(cfg, max_batch, max_seq)
+        self._free: list[int] = list(range(max_batch))
+        self._active: dict[int, GenRequest] = {}     # slot -> request
+        self._waiting: list[GenRequest] = []
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._tokens = np.zeros((max_batch,), np.int32)   # next input token
+        self._rng = jax.random.key(0)
+        self.steps = 0
+        self.generated_tokens = 0
+
+        self._prefill = jax.jit(
+            lambda p, toks, lens, cache: llama.prefill(
+                p, toks, cfg, cache, lengths=lens))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+
+    # ---------------- jitted bodies ----------------
+
+    def _decode_impl(self, params, token, cache, active, temperature,
+                     top_k, top_p, rng):
+        logits, cache = llama.decode_step(params, token, self.cfg, cache)
+        nxt = sample_logits(logits, rng, temperature, top_k, top_p)
+        # idle slots: pin len to 0 so their cursor can't creep toward max_seq
+        cache["len"] = jnp.where(active, cache["len"], 0)
+        return nxt, cache
+
+    def _insert_impl(self, cache, k_new, v_new, length, slot):
+        # k_new/v_new: [L, 1, bucket, H, K] -> rows [slot, :bucket] of arena
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.astype(cache["k"].dtype), (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.astype(cache["v"].dtype), (0, slot, 0, 0, 0))
+        ln = cache["len"].at[slot].set(length)
+        return {"k": k, "v": v, "len": ln}
+
+    # ---------------- public API ----------------
+
+    def add_request(self, prompt: Sequence[int],
+                    sampling: Optional[SamplingParams] = None) -> GenRequest:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + 1 > self.max_seq:
+            raise ValueError(f"prompt too long for max_seq={self.max_seq}")
+        if len(prompt) > self.buckets[-1]:
+            # reject HERE (caller's thread), not inside the scheduler loop —
+            # an exception in _admit would kill the engine for everyone
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds largest prefill "
+                f"bucket {self.buckets[-1]}")
+        req = GenRequest(id=next(self._ids), prompt=list(map(int, prompt)),
+                         sampling=sampling or SamplingParams())
+        with self._lock:
+            self._waiting.append(req)
+        return req
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._waiting or self._active)
+
+    def step(self) -> list[GenRequest]:
+        """Admit waiting requests, run one decode step, retire finished.
+        Returns requests that finished this step."""
+        self._admit()
+        if not self._active:
+            return []
+        active_mask = np.zeros((self.max_batch,), bool)
+        temp = np.zeros((self.max_batch,), np.float32)
+        top_k = np.zeros((self.max_batch,), np.int32)
+        top_p = np.ones((self.max_batch,), np.float32)
+        for slot, req in self._active.items():
+            active_mask[slot] = True
+            temp[slot] = req.sampling.temperature
+            top_k[slot] = req.sampling.top_k
+            top_p[slot] = req.sampling.top_p
+        self._rng, step_rng = jax.random.split(self._rng)
+        nxt, self.cache = self._decode(
+            self.params, jnp.asarray(self._tokens), self.cache,
+            jnp.asarray(active_mask), jnp.asarray(temp),
+            jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
+        nxt = np.asarray(nxt)
+        self.steps += 1
+
+        finished = []
+        for slot, req in list(self._active.items()):
+            tok = int(nxt[slot])
+            req.generated.append(tok)
+            self.generated_tokens += 1
+            self._tokens[slot] = tok
+            eos = req.sampling.eos_id
+            if (eos is not None and tok == eos) or \
+                    len(req.generated) >= req.sampling.max_tokens or \
+                    len(req.prompt) + len(req.generated) >= self.max_seq:
+                req.done = True
+                finished.append(req)
+                del self._active[slot]
+                self._free.append(slot)
+        return finished
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Optional[SamplingParams] = None,
+                 ) -> list[GenRequest]:
+        """Synchronous batch API: submit all, step until drained."""
+        reqs = [self.add_request(p, sampling) for p in prompts]
+        while self.has_work():
+            self.step()
+        return reqs
+
+    # ---------------- internals ----------------
+
+    def _admit(self) -> None:
+        while True:
+            with self._lock:
+                if not self._waiting or not self._free:
+                    return
+                req = self._waiting.pop(0)
+                slot = self._free.pop()
+            bucket = _bucket(len(req.prompt), self.buckets)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :len(req.prompt)] = req.prompt
+            scratch = llama.init_cache(self.cfg, 1, bucket)
+            logits, filled = self._prefill(
+                self.params, jnp.asarray(toks),
+                jnp.asarray([len(req.prompt)], jnp.int32), scratch)
+            self._rng, rng = jax.random.split(self._rng)
+            first = sample_logits(
+                logits, rng,
+                jnp.asarray([req.sampling.temperature], jnp.float32),
+                jnp.asarray([req.sampling.top_k], jnp.int32),
+                jnp.asarray([req.sampling.top_p], jnp.float32))
+            first_tok = int(np.asarray(first)[0])
+            self.cache = self._insert(
+                self.cache, filled["k"], filled["v"],
+                jnp.int32(len(req.prompt)), jnp.int32(slot))
+            # the prefill-sampled token is generation token #1; decode
+            # continues from it
+            req.generated.append(first_tok)
+            self.generated_tokens += 1
+            req.slot = slot
+            self._tokens[slot] = first_tok
+            self._active[slot] = req
+            eos = req.sampling.eos_id
+            if (eos is not None and first_tok == eos) or \
+                    req.sampling.max_tokens <= 1:
+                req.done = True
+                del self._active[slot]
+                self._free.append(slot)

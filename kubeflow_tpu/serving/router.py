@@ -1,0 +1,138 @@
+"""Graph router + traffic splitter — the reference's InferenceGraph router
+and Knative revision traffic split (SURVEY.md §2.4) as one in-process router
+that can front either local Models or remote InferenceClients.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Union
+
+from kubeflow_tpu.serving.model import Model, ModelRepository
+from kubeflow_tpu.serving.protocol import InferRequest, InferResponse
+from kubeflow_tpu.serving.server import InferenceClient
+from kubeflow_tpu.serving.types import (
+    GraphNode, GraphNodeType, GraphStep, InferenceGraph,
+)
+
+Backend = Union[Model, InferenceClient, Callable[[InferRequest], InferResponse]]
+
+
+def _call(backend: Backend, request: InferRequest) -> InferResponse:
+    if isinstance(backend, Model):
+        return backend(request)
+    if isinstance(backend, InferenceClient):
+        return backend.infer(request)
+    return backend(request)
+
+
+class GraphRouter:
+    """Executes an InferenceGraph over named backends.
+
+    Node semantics (matching the reference router):
+    - Sequence: steps run in order; a step with data="$response" receives the
+      previous step's outputs as its inputs.
+    - Switch: first step whose ``condition`` equals the request's
+      ``parameters['condition']`` runs; no match => error.
+    - Ensemble: all steps run on the same request; outputs are concatenated
+      (tensor names prefixed by step target).
+    - Splitter: one step chosen by weight (canary between model versions).
+    """
+
+    def __init__(self, graph: InferenceGraph, backends: dict[str, Backend],
+                 seed: int = 0):
+        graph.validate()
+        self.graph = graph
+        self.backends = backends
+        self._rng = random.Random(seed)
+
+    def route(self, request: InferRequest) -> InferResponse:
+        return self._run_node("root", request)
+
+    def _run_node(self, name: str, request: InferRequest) -> InferResponse:
+        node = self.graph.nodes[name]
+        if node.router_type == GraphNodeType.SEQUENCE:
+            return self._sequence(node, request)
+        if node.router_type == GraphNodeType.SWITCH:
+            return self._switch(node, request)
+        if node.router_type == GraphNodeType.ENSEMBLE:
+            return self._ensemble(node, request)
+        if node.router_type == GraphNodeType.SPLITTER:
+            return self._splitter(node, request)
+        raise ValueError(f"unknown node type {node.router_type}")
+
+    def _step(self, step: GraphStep, request: InferRequest) -> InferResponse:
+        if step.node is not None:
+            return self._run_node(step.node, request)
+        backend = self.backends.get(step.service)
+        if backend is None:
+            raise KeyError(f"no backend for service {step.service!r}")
+        return _call(backend, request)
+
+    def _sequence(self, node: GraphNode, request: InferRequest
+                  ) -> InferResponse:
+        current = request
+        response = None
+        for step in node.steps:
+            if step.data == "$response" and response is not None:
+                current = InferRequest(
+                    model_name=step.target(),
+                    inputs=response.outputs,
+                    id=request.id, parameters=request.parameters)
+            response = self._step(step, current)
+        return response
+
+    def _switch(self, node: GraphNode, request: InferRequest) -> InferResponse:
+        cond = request.parameters.get("condition")
+        for step in node.steps:
+            if step.condition is None or step.condition == cond:
+                return self._step(step, request)
+        raise ValueError(f"switch: no branch matches condition {cond!r}")
+
+    def _ensemble(self, node: GraphNode, request: InferRequest
+                  ) -> InferResponse:
+        outputs = []
+        for step in node.steps:
+            resp = self._step(step, request)
+            for t in resp.outputs:
+                t.name = f"{step.target()}.{t.name}"
+                outputs.append(t)
+        return InferResponse(model_name=self.graph.name, outputs=outputs,
+                             id=request.id)
+
+    def _splitter(self, node: GraphNode, request: InferRequest
+                  ) -> InferResponse:
+        total = sum(s.weight for s in node.steps)
+        pick = self._rng.uniform(0, total)
+        acc = 0.0
+        for step in node.steps:
+            acc += step.weight
+            if pick <= acc:
+                return self._step(step, request)
+        return self._step(node.steps[-1], request)
+
+
+class TrafficSplitter:
+    """Revision-level traffic split for canary rollout: routes a request to
+    one of the revisions' backends per the InferenceService status traffic
+    map (the ServingController maintains the map; this enforces it)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+
+    def pick(self, traffic: dict[int, int]) -> int:
+        if not traffic:
+            raise ValueError("no traffic targets")
+        total = sum(traffic.values())
+        pick = self._rng.uniform(0, total)
+        acc = 0.0
+        for revision, weight in sorted(traffic.items()):
+            acc += weight
+            if pick <= acc:
+                return revision
+        return max(traffic)
+
+
+def serve_repository(repository: ModelRepository) -> dict[str, Backend]:
+    """Expose every model in a repository as router backends."""
+    return {name: repository.get(name) for name in repository.names()}

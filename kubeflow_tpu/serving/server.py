@@ -1,0 +1,253 @@
+"""ModelServer — HTTP data plane serving V1 + V2 protocols.
+
+Parity: SURVEY.md §2.4 — the reference's kserve.ModelServer (FastAPI) with
+V1 (`/v1/models/X:predict`, `:explain`) and V2 Open Inference
+(`/v2/models/X/infer`, metadata, health) endpoints plus the model-repository
+hot load/unload API. Built on the stdlib ThreadingHTTPServer (no fastapi in
+this environment); the JAX compute inside is what matters on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib import request as urlrequest
+
+from kubeflow_tpu.serving.model import (
+    Model, ModelMissing, ModelNotReady, ModelRepository,
+)
+from kubeflow_tpu.serving.protocol import InferRequest, InferResponse
+
+_V1_PREDICT = re.compile(r"^/v1/models/([^/:]+):predict$")
+_V1_EXPLAIN = re.compile(r"^/v1/models/([^/:]+):explain$")
+_V1_MODEL = re.compile(r"^/v1/models/([^/:]+)$")
+_V2_INFER = re.compile(r"^/v2/models/([^/:]+)/infer$")
+_V2_MODEL = re.compile(r"^/v2/models/([^/:]+)$")
+_V2_MODEL_READY = re.compile(r"^/v2/models/([^/:]+)/ready$")
+_REPO_LOAD = re.compile(r"^/v2/repository/models/([^/:]+)/(load|unload)$")
+
+
+class ModelServer:
+    """Serves a ModelRepository over HTTP. ``start()`` runs in a daemon
+    thread and returns (host, port); in production this is the predictor
+    container's entrypoint."""
+
+    def __init__(self, repository: Optional[ModelRepository] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.repository = repository or ModelRepository()
+        self.request_count = 0
+        self.error_count = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b"{}"
+                return json.loads(raw or b"{}")
+
+            def do_GET(self):
+                outer.request_count += 1
+                try:
+                    self._get()
+                except BrokenPipeError:
+                    pass
+
+            def _get(self):
+                path = self.path
+                if path in ("/", "/v2", "/v2/"):
+                    return self._json(200, {
+                        "name": "kubeflow-tpu-modelserver",
+                        "extensions": ["model_repository"],
+                    })
+                if path in ("/v2/health/live", "/healthz"):
+                    return self._json(200, {"live": True})
+                if path == "/v2/health/ready":
+                    return self._json(200, {
+                        "ready": outer.repository.all_ready()})
+                if path == "/v2/repository/index":
+                    return self._json(200, [
+                        {"name": n, "state": "READY"
+                         if outer.repository.get(n).ready else "UNAVAILABLE"}
+                        for n in outer.repository.names()
+                    ])
+                if path == "/metrics":
+                    text = (
+                        f"kft_requests_total {outer.request_count}\n"
+                        f"kft_request_errors_total {outer.error_count}\n"
+                    )
+                    body = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                m = _V2_MODEL_READY.match(path)
+                if m:
+                    return self._with_model(m.group(1), lambda mod:
+                        self._json(200, {"name": mod.name, "ready": mod.ready}))
+                m = _V2_MODEL.match(path)
+                if m:
+                    return self._with_model(m.group(1), lambda mod:
+                        self._json(200, mod.metadata()))
+                m = _V1_MODEL.match(path)
+                if m:
+                    return self._with_model(m.group(1), lambda mod:
+                        self._json(200, {"name": mod.name, "ready": mod.ready}))
+                self._json(404, {"error": f"no route {path}"})
+
+            def do_POST(self):
+                outer.request_count += 1
+                try:
+                    self._post()
+                except BrokenPipeError:
+                    pass
+
+            def _post(self):
+                path = self.path
+                m = _V1_PREDICT.match(path)
+                if m:
+                    return self._infer(m.group(1), v1=True)
+                m = _V2_INFER.match(path)
+                if m:
+                    return self._infer(m.group(1), v1=False)
+                m = _V1_EXPLAIN.match(path)
+                if m:
+                    return self._explain(m.group(1))
+                m = _REPO_LOAD.match(path)
+                if m:
+                    name, action = m.group(1), m.group(2)
+                    try:
+                        if action == "load":
+                            outer.repository.get(name).load()
+                        else:
+                            outer.repository.unload(name)
+                        return self._json(200, {"name": name, "ok": True})
+                    except (ModelMissing, ModelNotReady) as e:
+                        outer.error_count += 1
+                        return self._json(404, {"error": str(e)})
+                    except Exception as e:   # load() failures become a 500
+                        outer.error_count += 1
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                self._json(404, {"error": f"no route {path}"})
+
+            def _with_model(self, name, fn):
+                try:
+                    return fn(outer.repository.get(name))
+                except ModelMissing as e:
+                    outer.error_count += 1
+                    return self._json(404, {"error": str(e)})
+
+            def _infer(self, name: str, v1: bool):
+                try:
+                    model = outer.repository.get(name)
+                    body = self._read_body()
+                    if v1:
+                        req = InferRequest.from_v1(name, body)
+                    else:
+                        req = InferRequest.from_dict(name, body)
+                    resp = model(req)
+                    return self._json(
+                        200, resp.to_v1() if v1 else resp.to_dict())
+                except ModelMissing as e:
+                    outer.error_count += 1
+                    return self._json(404, {"error": str(e)})
+                except ModelNotReady as e:
+                    outer.error_count += 1
+                    return self._json(503, {"error": str(e)})
+                except Exception as e:
+                    outer.error_count += 1
+                    return self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _explain(self, name: str):
+                try:
+                    model = outer.repository.get(name)
+                    req = InferRequest.from_v1(name, self._read_body())
+                    return self._json(200, model.explain(req))
+                except ModelMissing as e:
+                    outer.error_count += 1
+                    return self._json(404, {"error": str(e)})
+                except Exception as e:
+                    outer.error_count += 1
+                    return self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.address: tuple[str, int] = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "ModelServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+
+class InferenceClient:
+    """Minimal HTTP client for both protocols (tests + router transport)."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urlrequest.Request(
+            self.url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urlrequest.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def _get(self, path: str) -> dict:
+        with urlrequest.urlopen(self.url + path, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def predict_v1(self, model: str, instances: list, **params) -> dict:
+        body = {"instances": instances}
+        if params:
+            body["parameters"] = params
+        return self._post(f"/v1/models/{model}:predict", body)
+
+    def infer(self, request: InferRequest) -> InferResponse:
+        out = self._post(f"/v2/models/{request.model_name}/infer",
+                         request.to_dict())
+        return InferResponse.from_dict(out)
+
+    def explain_v1(self, model: str, instances: list) -> dict:
+        return self._post(f"/v1/models/{model}:explain",
+                          {"instances": instances})
+
+    def metadata(self, model: str) -> dict:
+        return self._get(f"/v2/models/{model}")
+
+    def ready(self) -> bool:
+        return bool(self._get("/v2/health/ready").get("ready"))
+
+    def load(self, model: str) -> dict:
+        return self._post(f"/v2/repository/models/{model}/load", {})
+
+    def unload(self, model: str) -> dict:
+        return self._post(f"/v2/repository/models/{model}/unload", {})

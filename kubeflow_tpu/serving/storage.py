@@ -1,0 +1,81 @@
+"""Storage initializer — model download by storageUri.
+
+Parity: SURVEY.md §2.4 'Storage' (kserve.storage + the agent downloader:
+gcs/s3/pvc/http/hf). TPU build keeps the same uri scheme dispatch; schemes
+whose SDKs aren't in this environment are gated with a clear error instead
+of a hard import.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+
+
+def download(storage_uri: str, dest_dir: str) -> str:
+    """Materialize the model behind ``storage_uri`` into ``dest_dir`` and
+    return the local path (the storage-initializer initContainer contract:
+    runs before the server starts, mounts at /mnt/models)."""
+    os.makedirs(dest_dir, exist_ok=True)
+    parsed = urllib.parse.urlparse(storage_uri)
+    scheme = parsed.scheme or "file"
+    if scheme == "file":
+        return _from_local(parsed.path or storage_uri, dest_dir)
+    if scheme == "pvc":
+        # pvc://volume/path — volume is mounted at /mnt/pvc/<volume> by the
+        # pod webhook; locally this is just a directory
+        path = os.path.join("/mnt/pvc", parsed.netloc,
+                            parsed.path.lstrip("/"))
+        return _from_local(path, dest_dir)
+    if scheme in ("http", "https"):
+        fname = os.path.basename(parsed.path) or "model"
+        target = os.path.join(dest_dir, fname)
+        urllib.request.urlretrieve(storage_uri, target)
+        return _maybe_unpack(target, dest_dir)
+    if scheme == "hf":
+        return _from_huggingface(parsed.netloc + parsed.path, dest_dir)
+    if scheme in ("gs", "s3", "azure"):
+        raise RuntimeError(
+            f"{scheme}:// downloads need the cloud SDK, which is not in "
+            f"this environment; mirror the model to a file:// or pvc:// "
+            f"path instead")
+    raise ValueError(f"unsupported storage uri scheme {scheme!r}")
+
+
+def _from_local(path: str, dest_dir: str) -> str:
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if os.path.isdir(path):
+        return path          # serve in place; no copy needed
+    return _maybe_unpack(path, dest_dir, copy=True)
+
+
+def _maybe_unpack(path: str, dest_dir: str, copy: bool = False) -> str:
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as tf:
+            tf.extractall(dest_dir, filter="data")
+        return dest_dir
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as zf:
+            zf.extractall(dest_dir)
+        return dest_dir
+    if copy:
+        target = os.path.join(dest_dir, os.path.basename(path))
+        if os.path.abspath(target) != os.path.abspath(path):
+            shutil.copy2(path, target)
+        return target
+    return path
+
+
+def _from_huggingface(repo_id: str, dest_dir: str) -> str:
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:
+        raise RuntimeError(
+            "hf:// uris need huggingface_hub (bundled with transformers); "
+            f"import failed: {e}") from e
+    return snapshot_download(repo_id=repo_id, local_dir=dest_dir)

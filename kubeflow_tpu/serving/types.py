@@ -1,0 +1,160 @@
+"""Serving API types — InferenceService / ServingRuntime / InferenceGraph.
+
+Parity with the reference's KServe API surface (SURVEY.md §2.4: predictor/
+transformer/explainer specs, canary traffic %, min/max replicas,
+ServingRuntime matched by model format, InferenceGraph DAG, TrainedModel
+multi-model), TPU-first: runtimes request TPU slices by topology and carry
+an AOT-compile/warmup contract instead of GPU resource counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+from kubeflow_tpu.api.types import TPUSpec
+
+
+@dataclasses.dataclass
+class ModelFormat:
+    name: str                      # e.g. "llama", "sklearn", "jax-saved"
+    version: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ServingRuntime:
+    """Template for a runtime pod serving one or more model formats
+    (ClusterServingRuntime when namespace is None)."""
+
+    name: str
+    supported_formats: list[ModelFormat]
+    image: str = "kubeflow-tpu/serving:latest"
+    command: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    tpu: Optional[TPUSpec] = None
+    namespace: Optional[str] = None     # None => cluster-scoped
+    priority: int = 0                   # higher wins on multi-match
+    # TPU cold-start contract: persistent XLA compile cache + warmup shapes
+    compile_cache_dir: Optional[str] = None
+    warmup_shapes: list[list[int]] = dataclasses.field(default_factory=list)
+
+    def supports(self, fmt: ModelFormat) -> bool:
+        return any(
+            f.name == fmt.name and
+            (f.version is None or fmt.version is None or
+             f.version == fmt.version)
+            for f in self.supported_formats
+        )
+
+
+@dataclasses.dataclass
+class PredictorSpec:
+    model_format: ModelFormat = dataclasses.field(
+        default_factory=lambda: ModelFormat("jax"))
+    storage_uri: Optional[str] = None
+    runtime: Optional[str] = None       # explicit ServingRuntime name
+    min_replicas: int = 1
+    max_replicas: int = 1
+    scale_metric: str = "concurrency"
+    scale_target: int = 8
+    canary_traffic_percent: Optional[int] = None   # % to the LATEST revision
+    tpu: Optional[TPUSpec] = None
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ComponentSpec:
+    """Transformer or explainer container spec."""
+
+    image: str = "kubeflow-tpu/serving:latest"
+    command: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    min_replicas: int = 1
+    max_replicas: int = 1
+
+
+@dataclasses.dataclass
+class InferenceServiceStatus:
+    ready: bool = False
+    url: Optional[str] = None
+    latest_revision: int = 0
+    ready_revision: int = 0
+    traffic: dict[int, int] = dataclasses.field(default_factory=dict)
+    conditions: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class InferenceService:
+    name: str
+    predictor: PredictorSpec
+    transformer: Optional[ComponentSpec] = None
+    explainer: Optional[ComponentSpec] = None
+    namespace: str = "default"
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    status: InferenceServiceStatus = dataclasses.field(
+        default_factory=InferenceServiceStatus)
+    generation: int = 0           # bumped on every spec change
+
+
+# ---------------------------------------------------------------- graph ----
+
+class GraphNodeType(str, enum.Enum):
+    SEQUENCE = "Sequence"
+    SWITCH = "Switch"
+    ENSEMBLE = "Ensemble"
+    SPLITTER = "Splitter"
+
+
+@dataclasses.dataclass
+class GraphStep:
+    """One routing target inside a node: an InferenceService name or another
+    graph node."""
+
+    service: Optional[str] = None       # InferenceService / model name
+    node: Optional[str] = None          # nested node name
+    condition: Optional[str] = None     # Switch: matched against request data
+    weight: int = 100                   # Splitter: traffic weight
+    data: str = "$request"              # Sequence: "$request" or "$response"
+
+    def target(self) -> str:
+        return self.service or self.node or ""
+
+
+@dataclasses.dataclass
+class GraphNode:
+    router_type: GraphNodeType
+    steps: list[GraphStep]
+
+
+@dataclasses.dataclass
+class InferenceGraph:
+    name: str
+    nodes: dict[str, GraphNode]         # must contain "root"
+    namespace: str = "default"
+
+    def validate(self) -> None:
+        if "root" not in self.nodes:
+            raise ValueError("inference graph needs a 'root' node")
+        for name, node in self.nodes.items():
+            if not node.steps:
+                raise ValueError(f"graph node {name!r} has no steps")
+            for s in node.steps:
+                if s.node is not None and s.node not in self.nodes:
+                    raise ValueError(
+                        f"node {name!r} references unknown node {s.node!r}")
+                if not s.target():
+                    raise ValueError(f"node {name!r} has an empty step")
+
+
+@dataclasses.dataclass
+class TrainedModel:
+    """Multi-model: attach a model to an existing InferenceService's
+    runtime (the model-repository hot-load path)."""
+
+    name: str
+    inference_service: str
+    model_format: ModelFormat = dataclasses.field(
+        default_factory=lambda: ModelFormat("jax"))
+    storage_uri: Optional[str] = None
+    namespace: str = "default"

@@ -1,0 +1,286 @@
+"""Serving layer tests — HTTP round trips (the reference's KServe e2e predict
+assertions, SURVEY.md §4.3), controller/canary reconcile with a FakeCluster,
+runtime matching, graph routing, autoscaling."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.controller.cluster import FakeCluster, PodPhase
+from kubeflow_tpu.serving import (
+    Autoscaler, ComponentSpec, GraphNode, GraphNodeType, GraphRouter,
+    GraphStep, InferRequest, InferResponse, InferTensor, InferenceClient,
+    InferenceGraph, InferenceService, JAXModel, Model, ModelFormat,
+    ModelRepository, ModelServer, PredictorSpec, RuntimeRegistry,
+    ServingController, ServingRuntime, TrafficSplitter,
+)
+
+
+class Doubler(Model):
+    def predict(self, request):
+        x = request.as_numpy()
+        return InferResponse.from_numpy(self.name, {"output-0": x * 2},
+                                        id=request.id)
+
+
+class AddOne(Model):
+    def predict(self, request):
+        x = request.as_numpy().astype(np.float64)
+        return InferResponse.from_numpy(self.name, {"output-0": x + 1},
+                                        id=request.id)
+
+    def explain(self, request):
+        return {"explanations": ["adds one"]}
+
+
+@pytest.fixture()
+def server():
+    repo = ModelRepository()
+    repo.register(Doubler("double"))
+    repo.register(AddOne("addone"))
+    srv = ModelServer(repo).start()
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------- protocol
+
+def test_v2_tensor_roundtrip():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = InferTensor.from_numpy("x", arr)
+    assert t.datatype == "FP32" and t.shape == [3, 4]
+    np.testing.assert_array_equal(t.to_numpy(), arr)
+    d = t.to_dict()
+    np.testing.assert_array_equal(InferTensor.from_dict(d).to_numpy(), arr)
+
+
+def test_v1_request_adapter():
+    req = InferRequest.from_v1("m", {"instances": [[1.0, 2.0], [3.0, 4.0]]})
+    assert req.as_numpy().shape == (2, 2)
+
+
+# ---------------------------------------------------------------- server
+
+def test_v1_predict_roundtrip(server):
+    client = InferenceClient(server.url)
+    out = client.predict_v1("double", [[1.0, 2.0], [3.0, 4.0]])
+    assert out["predictions"] == [[2.0, 4.0], [6.0, 8.0]]
+
+
+def test_v2_infer_roundtrip(server):
+    client = InferenceClient(server.url)
+    req = InferRequest(model_name="addone", inputs=[
+        InferTensor.from_numpy("x", np.array([[1.0, 2.0]], np.float32))])
+    resp = client.infer(req)
+    np.testing.assert_allclose(resp.as_numpy(), [[2.0, 3.0]])
+
+
+def test_v2_metadata_health_and_repo(server):
+    client = InferenceClient(server.url)
+    assert client.ready()
+    md = client.metadata("double")
+    assert md["name"] == "double"
+    client.unload("double")
+    with pytest.raises(Exception):
+        client.predict_v1("double", [[1.0]])
+    # addone still serves; repository index no longer lists double
+    assert client.predict_v1("addone", [[1.0]])["predictions"] == [[2.0]]
+
+
+def test_explain_endpoint(server):
+    client = InferenceClient(server.url)
+    out = client.explain_v1("addone", [[1.0]])
+    assert out == {"explanations": ["adds one"]}
+
+
+def test_missing_model_404(server):
+    client = InferenceClient(server.url)
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        client.predict_v1("nope", [[1.0]])
+    assert e.value.code == 404
+
+
+# ---------------------------------------------------------------- jax model
+
+def test_jax_model_bucketing():
+    def fn(params, x):
+        return x @ params
+
+    w = np.eye(3, dtype=np.float32) * 3
+    m = JAXModel("lin", fn, params=w, batch_buckets=(2, 4), warmup=False)
+    m.load()
+    req = InferRequest(model_name="lin", inputs=[
+        InferTensor.from_numpy("x", np.ones((3, 3), np.float32))])
+    out = m(req).as_numpy()
+    assert out.shape == (3, 3)          # padding trimmed back off
+    np.testing.assert_allclose(out, 3 * np.ones((3, 3)))
+
+
+# ---------------------------------------------------------------- controller
+
+def _runtime(name="jax-runtime", fmt="jax", priority=0, namespace=None):
+    return ServingRuntime(name=name, supported_formats=[ModelFormat(fmt)],
+                          priority=priority, namespace=namespace)
+
+
+def test_runtime_matching_priority_and_namespace():
+    reg = RuntimeRegistry()
+    reg.register(_runtime("cluster-low", priority=1))
+    reg.register(_runtime("cluster-high", priority=5))
+    reg.register(_runtime("ns-local", namespace="prod"))
+    assert reg.select(ModelFormat("jax"), "dev").name == "cluster-high"
+    # namespace-local beats cluster-scoped regardless of priority
+    assert reg.select(ModelFormat("jax"), "prod").name == "ns-local"
+    assert reg.select(ModelFormat("onnx"), "dev") is None
+
+
+def _ready_all(cluster):
+    for (ns, name), pod in list(cluster.pods.items()):
+        if pod.phase == PodPhase.PENDING:
+            cluster.set_phase(ns, name, PodPhase.RUNNING)
+
+
+def test_isvc_reconcile_to_ready():
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(_runtime())
+    ctl = ServingController(cluster, reg)
+    isvc = InferenceService(
+        name="m", predictor=PredictorSpec(model_format=ModelFormat("jax"),
+                                          min_replicas=2),
+        transformer=ComponentSpec(min_replicas=1))
+    ctl.apply(isvc)
+    assert not isvc.status.ready
+    assert len(cluster.pods) == 3       # 2 predictors + 1 transformer
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert isvc.status.ready
+    assert isvc.status.traffic == {1: 100}
+
+
+def test_canary_rollout_promote():
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(_runtime())
+    ctl = ServingController(cluster, reg)
+    isvc = InferenceService(name="m", predictor=PredictorSpec())
+    ctl.apply(isvc)
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert isvc.status.ready_revision == 1
+
+    # spec change with 20% canary
+    isvc2 = InferenceService(
+        name="m",
+        predictor=PredictorSpec(canary_traffic_percent=20,
+                                env={"NEW": "1"}))
+    ctl.apply(isvc2)
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert ctl.get("default", "m").status.traffic == {2: 20, 1: 80}
+
+    ctl.promote("default", "m")
+    status = ctl.get("default", "m").status
+    assert status.traffic == {2: 100}
+    assert status.ready_revision == 2
+    # old revision pods garbage-collected
+    revs = {p.labels["revision"] for p in cluster.pods.values()}
+    assert revs == {"2"}
+
+
+def test_canary_rollback():
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(_runtime())
+    ctl = ServingController(cluster, reg)
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec()))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    ctl.apply(InferenceService(
+        name="m", predictor=PredictorSpec(canary_traffic_percent=10)))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    ctl.rollback("default", "m")
+    status = ctl.get("default", "m").status
+    assert status.traffic == {1: 100}
+    revs = {p.labels["revision"] for p in cluster.pods.values()}
+    assert revs == {"1"}
+
+
+def test_traffic_splitter_distribution():
+    sp = TrafficSplitter(seed=7)
+    picks = collections.Counter(sp.pick({1: 80, 2: 20}) for _ in range(2000))
+    assert 0.7 < picks[1] / 2000 < 0.9
+
+
+def test_autoscaler():
+    sc = Autoscaler(idle_grace_seconds=10)
+    isvc = InferenceService(
+        name="m", predictor=PredictorSpec(min_replicas=1, max_replicas=5,
+                                          scale_target=4))
+    assert sc.scale(isvc, 0, now=0.0) == 1
+    assert sc.scale(isvc, 9, now=1.0) == 3
+    assert sc.scale(isvc, 100, now=2.0) == 5
+    isvc0 = InferenceService(
+        name="z", predictor=PredictorSpec(min_replicas=0, max_replicas=3,
+                                          scale_target=4))
+    assert sc.scale(isvc0, 4, now=0.0) == 1
+    assert sc.scale(isvc0, 0, now=5.0) == 1     # within grace
+    assert sc.scale(isvc0, 0, now=20.0) == 0    # scale to zero
+
+
+# ---------------------------------------------------------------- graph
+
+def _req(vals):
+    return InferRequest(model_name="g", inputs=[
+        InferTensor.from_numpy("x", np.asarray(vals, np.float32))])
+
+
+def test_graph_sequence_pipes_response():
+    graph = InferenceGraph(name="g", nodes={
+        "root": GraphNode(GraphNodeType.SEQUENCE, steps=[
+            GraphStep(service="addone"),
+            GraphStep(service="double", data="$response"),
+        ])})
+    router = GraphRouter(graph, {"addone": AddOne("addone"),
+                                 "double": Doubler("double")})
+    for m in router.backends.values():
+        m.load()
+    out = router.route(_req([[1.0]])).as_numpy()
+    np.testing.assert_allclose(out, [[4.0]])    # (1+1)*2
+
+
+def test_graph_switch_and_ensemble():
+    graph = InferenceGraph(name="g", nodes={
+        "root": GraphNode(GraphNodeType.SWITCH, steps=[
+            GraphStep(service="addone", condition="a"),
+            GraphStep(node="both", condition="b"),
+        ]),
+        "both": GraphNode(GraphNodeType.ENSEMBLE, steps=[
+            GraphStep(service="addone"), GraphStep(service="double"),
+        ])})
+    backends = {"addone": AddOne("addone"), "double": Doubler("double")}
+    for m in backends.values():
+        m.load()
+    router = GraphRouter(graph, backends)
+
+    req = _req([[2.0]])
+    req.parameters["condition"] = "a"
+    np.testing.assert_allclose(router.route(req).as_numpy(), [[3.0]])
+
+    req.parameters["condition"] = "b"
+    resp = router.route(req)
+    names = [t.name for t in resp.outputs]
+    assert names == ["addone.output-0", "double.output-0"]
+
+
+def test_graph_validation():
+    with pytest.raises(ValueError):
+        InferenceGraph(name="g", nodes={}).validate()
+    with pytest.raises(ValueError):
+        InferenceGraph(name="g", nodes={
+            "root": GraphNode(GraphNodeType.SEQUENCE,
+                              steps=[GraphStep(node="missing")])
+        }).validate()
