@@ -1,0 +1,13 @@
+"""Metadata / lineage layer — MLMD-equivalent (SURVEY.md §2.5)."""
+
+from kubeflow_tpu.metadata.client import (
+    MetadataClient, MetadataServerProcess, build_native,
+)
+from kubeflow_tpu.metadata.store import (
+    INPUT, OUTPUT, Artifact, Context, Event, Execution, MetadataStore,
+)
+
+__all__ = [
+    "Artifact", "Context", "Event", "Execution", "INPUT", "MetadataClient",
+    "MetadataServerProcess", "MetadataStore", "OUTPUT", "build_native",
+]

@@ -1,0 +1,187 @@
+"""Client for the native metadata server + launcher.
+
+``MetadataClient`` speaks the length-prefixed-JSON protocol of
+``native/metadata_store/metadata_store.cc`` and exposes the SAME method
+surface as the in-proc ``MetadataStore``, so the pipeline runner takes
+either (duck-typed backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import threading
+from typing import Any, Optional
+
+from kubeflow_tpu.metadata.store import Artifact, Context, Execution
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "metadata_store")
+NATIVE_BIN = os.path.join(NATIVE_DIR, "metadata_store")
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the C++ server (idempotent). Returns the binary path."""
+    if force or not os.path.exists(NATIVE_BIN) or (
+            os.path.getmtime(NATIVE_BIN)
+            < os.path.getmtime(os.path.join(NATIVE_DIR, "metadata_store.cc"))):
+        subprocess.run(["make", "-s"], cwd=NATIVE_DIR, check=True)
+    return NATIVE_BIN
+
+
+class MetadataServerProcess:
+    """Launches the native server as a child process; handshake via the
+    LISTENING line on stdout."""
+
+    def __init__(self, wal_path: Optional[str] = None, port: int = 0):
+        args = [build_native(), "--port", str(port)]
+        if wal_path:
+            args += ["--wal", wal_path]
+        self.proc = subprocess.Popen(
+            args, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        line = self.proc.stdout.readline().strip()
+        if not line.startswith("LISTENING "):
+            self.proc.kill()
+            raise RuntimeError(f"metadata server failed to start: {line!r}")
+        self.port = int(line.split()[1])
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+def _artifact(d: dict) -> Artifact:
+    return Artifact(id=int(d["id"]), type=d.get("type", ""),
+                    uri=d.get("uri", ""), name=d.get("name", ""),
+                    state=d.get("state", "LIVE"),
+                    properties=d.get("properties", {}))
+
+
+def _execution(d: dict) -> Execution:
+    return Execution(id=int(d["id"]), type=d.get("type", ""),
+                     name=d.get("name", ""), state=d.get("state", "RUNNING"),
+                     properties=d.get("properties", {}))
+
+
+class MetadataClient:
+    """Same API as metadata.store.MetadataStore, over the wire."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def _call(self, method: str, **kwargs: Any) -> dict:
+        req = json.dumps({"method": method, **kwargs}).encode()
+        with self._lock:
+            self._sock.sendall(struct.pack(">I", len(req)) + req)
+            hdr = self._recv(4)
+            (n,) = struct.unpack(">I", hdr)
+            body = self._recv(n)
+        resp = json.loads(body)
+        if "error" in resp:
+            raise KeyError(resp["error"])
+        return resp
+
+    def _recv(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("metadata server closed connection")
+            buf += chunk
+        return buf
+
+    # --- writes (mirror MetadataStore) ---
+
+    def put_artifact(self, type: str, uri: str = "", name: str = "",
+                     properties: Optional[dict] = None,
+                     state: str = "LIVE") -> int:
+        return int(self._call("PutArtifact", type=type, uri=uri, name=name,
+                              properties=properties or {}, state=state)["id"])
+
+    def put_execution(self, type: str, name: str = "",
+                      properties: Optional[dict] = None,
+                      state: str = "RUNNING") -> int:
+        return int(self._call("PutExecution", type=type, name=name,
+                              properties=properties or {}, state=state)["id"])
+
+    def put_context(self, type: str, name: str,
+                    properties: Optional[dict] = None) -> int:
+        return int(self._call("PutContext", type=type, name=name,
+                              properties=properties or {})["id"])
+
+    def update_execution(self, execution_id: int, state: Optional[str] = None,
+                         properties: Optional[dict] = None) -> None:
+        self._call("UpdateExecution", id=execution_id, state=state or "",
+                   properties=properties or {})
+
+    def put_event(self, execution_id: int, artifact_id: int, type: str,
+                  path: str = "") -> None:
+        self._call("PutEvent", execution=execution_id, artifact=artifact_id,
+                   type=type, path=path)
+
+    def associate(self, context_id: int, execution_id: int) -> None:
+        self._call("Associate", context=context_id, execution=execution_id)
+
+    def attribute(self, context_id: int, artifact_id: int) -> None:
+        self._call("Attribute", context=context_id, artifact=artifact_id)
+
+    # --- reads ---
+
+    def get_artifact(self, artifact_id: int) -> Artifact:
+        return _artifact(self._call("GetArtifact", id=artifact_id))
+
+    def get_execution(self, execution_id: int) -> Execution:
+        return _execution(self._call("GetExecution", id=execution_id))
+
+    def context_by_name(self, type: str, name: str) -> Optional[Context]:
+        try:
+            d = self._call("ContextByName", type=type, name=name)
+        except KeyError:
+            return None
+        return Context(id=int(d["id"]), type=d.get("type", ""),
+                       name=d.get("name", ""),
+                       properties=d.get("properties", {}))
+
+    def executions_in_context(self, context_id: int) -> list[Execution]:
+        return [_execution(d) for d in
+                self._call("ExecutionsInContext", context=context_id)["items"]]
+
+    def artifacts_in_context(self, context_id: int) -> list[Artifact]:
+        return [_artifact(d) for d in
+                self._call("ArtifactsInContext", context=context_id)["items"]]
+
+    def producer(self, artifact_id: int) -> Optional[Execution]:
+        try:
+            return _execution(self._call("Producer", artifact=artifact_id))
+        except KeyError:
+            return None
+
+    def inputs_of(self, execution_id: int) -> list[Artifact]:
+        return [_artifact(d) for d in
+                self._call("InputsOf", execution=execution_id)["items"]]
+
+    def outputs_of(self, execution_id: int) -> list[Artifact]:
+        return [_artifact(d) for d in
+                self._call("OutputsOf", execution=execution_id)["items"]]
+
+    def upstream_artifacts(self, artifact_id: int, **_: Any) -> list[Artifact]:
+        return [_artifact(d) for d in
+                self._call("UpstreamArtifacts", artifact=artifact_id)["items"]]
+
+    def downstream_artifacts(self, artifact_id: int,
+                             **_: Any) -> list[Artifact]:
+        return [_artifact(d) for d in
+                self._call("DownstreamArtifacts",
+                           artifact=artifact_id)["items"]]

@@ -1,0 +1,306 @@
+"""Metadata / lineage store — the MLMD equivalent (SURVEY.md §2.5 ◆◆).
+
+Same data model as the reference's ml-metadata: typed **Artifacts**,
+**Executions**, and **Contexts** with property maps, linked by **Events**
+(execution INPUT/OUTPUT artifact) and **Associations** (context membership).
+Lineage queries walk events.
+
+Two backends, one API:
+- this pure-Python store (in-proc; JSONL WAL for persistence) — used by
+  tests and the local pipeline runner;
+- the native C++ server (``native/metadata_store.cc``) speaking the same
+  length-prefixed-JSON protocol, fronted by ``client.MetadataClient``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+Properties = dict[str, Any]
+
+INPUT = "INPUT"
+OUTPUT = "OUTPUT"
+
+
+@dataclasses.dataclass
+class Artifact:
+    id: int
+    type: str
+    uri: str = ""
+    name: str = ""
+    state: str = "LIVE"        # PENDING | LIVE | DELETED
+    properties: Properties = dataclasses.field(default_factory=dict)
+    create_time: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class Execution:
+    id: int
+    type: str
+    name: str = ""
+    state: str = "RUNNING"     # RUNNING | COMPLETE | FAILED | CACHED
+    properties: Properties = dataclasses.field(default_factory=dict)
+    create_time: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class Context:
+    id: int
+    type: str                  # e.g. "pipeline_run", "experiment"
+    name: str = ""
+    properties: Properties = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Event:
+    execution_id: int
+    artifact_id: int
+    type: str                  # INPUT | OUTPUT
+    path: str = ""             # the named input/output slot
+
+
+class MetadataStore:
+    """In-memory store with optional JSONL write-ahead log persistence."""
+
+    def __init__(self, wal_path: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._ids = 0
+        self.artifacts: dict[int, Artifact] = {}
+        self.executions: dict[int, Execution] = {}
+        self.contexts: dict[int, Context] = {}
+        self.events: list[Event] = []
+        self.associations: list[tuple[int, int]] = []   # (context, execution)
+        self.attributions: list[tuple[int, int]] = []   # (context, artifact)
+        self._wal_path = wal_path
+        self._wal_file = None
+        if wal_path and os.path.exists(wal_path):
+            self._replay(wal_path)
+        if wal_path:
+            # one append handle kept open: _log runs under the store lock,
+            # and per-record open/close would serialize tasks on file opens
+            self._wal_file = open(wal_path, "a")
+
+    # ------------- writes -------------
+
+    def put_artifact(self, type: str, uri: str = "", name: str = "",
+                     properties: Optional[Properties] = None,
+                     state: str = "LIVE") -> int:
+        with self._lock:
+            aid = self._next_id()
+            self.artifacts[aid] = Artifact(
+                id=aid, type=type, uri=uri, name=name, state=state,
+                properties=dict(properties or {}))
+            self._log({"op": "artifact", "id": aid, "type": type, "uri": uri,
+                       "name": name, "state": state,
+                       "properties": self.artifacts[aid].properties})
+            return aid
+
+    def put_execution(self, type: str, name: str = "",
+                      properties: Optional[Properties] = None,
+                      state: str = "RUNNING") -> int:
+        with self._lock:
+            eid = self._next_id()
+            self.executions[eid] = Execution(
+                id=eid, type=type, name=name, state=state,
+                properties=dict(properties or {}))
+            self._log({"op": "execution", "id": eid, "type": type,
+                       "name": name, "state": state,
+                       "properties": self.executions[eid].properties})
+            return eid
+
+    def put_context(self, type: str, name: str,
+                    properties: Optional[Properties] = None) -> int:
+        with self._lock:
+            for c in self.contexts.values():
+                if c.type == type and c.name == name:
+                    return c.id
+            cid = self._next_id()
+            self.contexts[cid] = Context(
+                id=cid, type=type, name=name,
+                properties=dict(properties or {}))
+            self._log({"op": "context", "id": cid, "type": type, "name": name,
+                       "properties": self.contexts[cid].properties})
+            return cid
+
+    def update_execution(self, execution_id: int, state: Optional[str] = None,
+                         properties: Optional[Properties] = None) -> None:
+        with self._lock:
+            ex = self.executions[execution_id]
+            if state is not None:
+                ex.state = state
+            if properties:
+                ex.properties.update(properties)
+            self._log({"op": "update_execution", "id": execution_id,
+                       "state": state, "properties": properties or {}})
+
+    def put_event(self, execution_id: int, artifact_id: int, type: str,
+                  path: str = "") -> None:
+        with self._lock:
+            if execution_id not in self.executions:
+                raise KeyError(f"no execution {execution_id}")
+            if artifact_id not in self.artifacts:
+                raise KeyError(f"no artifact {artifact_id}")
+            self.events.append(Event(execution_id, artifact_id, type, path))
+            self._log({"op": "event", "execution": execution_id,
+                       "artifact": artifact_id, "type": type, "path": path})
+
+    def associate(self, context_id: int, execution_id: int) -> None:
+        with self._lock:
+            self.associations.append((context_id, execution_id))
+            self._log({"op": "assoc", "context": context_id,
+                       "execution": execution_id})
+
+    def attribute(self, context_id: int, artifact_id: int) -> None:
+        with self._lock:
+            self.attributions.append((context_id, artifact_id))
+            self._log({"op": "attr", "context": context_id,
+                       "artifact": artifact_id})
+
+    # ------------- reads -------------
+
+    def get_artifact(self, artifact_id: int) -> Artifact:
+        return self.artifacts[artifact_id]
+
+    def get_execution(self, execution_id: int) -> Execution:
+        return self.executions[execution_id]
+
+    def executions_in_context(self, context_id: int) -> list[Execution]:
+        with self._lock:
+            return [self.executions[e] for c, e in self.associations
+                    if c == context_id]
+
+    def artifacts_in_context(self, context_id: int) -> list[Artifact]:
+        with self._lock:
+            return [self.artifacts[a] for c, a in self.attributions
+                    if c == context_id]
+
+    def context_by_name(self, type: str, name: str) -> Optional[Context]:
+        with self._lock:
+            for c in self.contexts.values():
+                if c.type == type and c.name == name:
+                    return c
+            return None
+
+    # ------------- lineage -------------
+
+    def producer(self, artifact_id: int) -> Optional[Execution]:
+        """The execution that OUTPUT this artifact."""
+        with self._lock:
+            for ev in self.events:
+                if ev.artifact_id == artifact_id and ev.type == OUTPUT:
+                    return self.executions[ev.execution_id]
+            return None
+
+    def inputs_of(self, execution_id: int) -> list[Artifact]:
+        with self._lock:
+            return [self.artifacts[ev.artifact_id] for ev in self.events
+                    if ev.execution_id == execution_id and ev.type == INPUT]
+
+    def outputs_of(self, execution_id: int) -> list[Artifact]:
+        with self._lock:
+            return [self.artifacts[ev.artifact_id] for ev in self.events
+                    if ev.execution_id == execution_id and ev.type == OUTPUT]
+
+    def upstream_artifacts(self, artifact_id: int,
+                           max_hops: int = 100) -> list[Artifact]:
+        """Full provenance: every artifact this one transitively depends on."""
+        seen: set[int] = set()
+        frontier = [artifact_id]
+        out = []
+        for _ in range(max_hops):
+            if not frontier:
+                break
+            nxt = []
+            for aid in frontier:
+                producer = self.producer(aid)
+                if producer is None:
+                    continue
+                for art in self.inputs_of(producer.id):
+                    if art.id not in seen:
+                        seen.add(art.id)
+                        out.append(art)
+                        nxt.append(art.id)
+            frontier = nxt
+        return out
+
+    def downstream_artifacts(self, artifact_id: int,
+                             max_hops: int = 100) -> list[Artifact]:
+        seen: set[int] = set()
+        frontier = [artifact_id]
+        out = []
+        for _ in range(max_hops):
+            if not frontier:
+                break
+            nxt = []
+            for aid in frontier:
+                with self._lock:
+                    consumers = {ev.execution_id for ev in self.events
+                                 if ev.artifact_id == aid and ev.type == INPUT}
+                for eid in consumers:
+                    for art in self.outputs_of(eid):
+                        if art.id not in seen:
+                            seen.add(art.id)
+                            out.append(art)
+                            nxt.append(art.id)
+            frontier = nxt
+        return out
+
+    # ------------- internals -------------
+
+    def _next_id(self) -> int:
+        self._ids += 1
+        return self._ids
+
+    def _log(self, rec: dict) -> None:
+        if self._wal_file is not None:
+            self._wal_file.write(json.dumps(rec) + "\n")
+            self._wal_file.flush()
+
+    def _replay(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue   # torn write; skip the record
+                op = rec.get("op")
+                if op == "artifact":
+                    self.artifacts[rec["id"]] = Artifact(
+                        id=rec["id"], type=rec["type"], uri=rec["uri"],
+                        name=rec["name"], state=rec["state"],
+                        properties=rec["properties"])
+                    self._ids = max(self._ids, rec["id"])
+                elif op == "execution":
+                    self.executions[rec["id"]] = Execution(
+                        id=rec["id"], type=rec["type"], name=rec["name"],
+                        state=rec["state"], properties=rec["properties"])
+                    self._ids = max(self._ids, rec["id"])
+                elif op == "context":
+                    self.contexts[rec["id"]] = Context(
+                        id=rec["id"], type=rec["type"], name=rec["name"],
+                        properties=rec["properties"])
+                    self._ids = max(self._ids, rec["id"])
+                elif op == "update_execution":
+                    ex = self.executions.get(rec["id"])
+                    if ex:
+                        if rec.get("state"):
+                            ex.state = rec["state"]
+                        ex.properties.update(rec.get("properties", {}))
+                elif op == "event":
+                    self.events.append(Event(
+                        rec["execution"], rec["artifact"], rec["type"],
+                        rec.get("path", "")))
+                elif op == "assoc":
+                    self.associations.append(
+                        (rec["context"], rec["execution"]))
+                elif op == "attr":
+                    self.attributions.append(
+                        (rec["context"], rec["artifact"]))

@@ -1,0 +1,21 @@
+"""Pipelines layer — KFP-equivalent DSL, compiler, DAG runner, lineage
+(SURVEY.md §2.5)."""
+
+from kubeflow_tpu.pipelines.client import PipelineClient, RecurringRun
+from kubeflow_tpu.pipelines.compiler import (
+    Compiler, compile_pipeline, load_ir,
+)
+from kubeflow_tpu.pipelines.dsl import (
+    Artifact, Condition, Dataset, ExitHandler, Input, Metrics, Model, Output,
+    ParallelFor, Pipeline, Task, component, pipeline,
+)
+from kubeflow_tpu.pipelines.runner import (
+    LocalRunner, RunResult, TaskResult, TaskState,
+)
+
+__all__ = [
+    "Artifact", "Compiler", "Condition", "Dataset", "ExitHandler", "Input",
+    "LocalRunner", "Metrics", "Model", "Output", "ParallelFor", "Pipeline",
+    "PipelineClient", "RecurringRun", "RunResult", "Task", "TaskResult",
+    "TaskState", "compile_pipeline", "component", "load_ir", "pipeline",
+]

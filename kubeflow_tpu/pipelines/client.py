@@ -1,0 +1,126 @@
+"""Pipeline client + run store + recurring runs.
+
+The ml-pipeline API-server surface (SURVEY.md §2.5: PipelineService /
+RunService / ExperimentService / RecurringRunService) reduced to its
+capability set: register pipelines, create/list/get runs, recurring runs on
+an interval schedule (the ScheduledWorkflow controller role).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Optional
+
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.pipelines.runner import LocalRunner, RunResult, TaskState
+
+
+@dataclasses.dataclass
+class RecurringRun:
+    name: str
+    pipeline: str
+    interval_seconds: float
+    arguments: dict[str, Any] = dataclasses.field(default_factory=dict)
+    enabled: bool = True
+    last_fire: float = 0.0
+    max_concurrency: int = 1
+    run_ids: list[str] = dataclasses.field(default_factory=list)
+    _inflight: int = 0
+
+
+class PipelineClient:
+    """kfp.Client-equivalent over a LocalRunner backend."""
+
+    def __init__(self, runner: LocalRunner):
+        self.runner = runner
+        self._pipelines: dict[str, dsl.Pipeline] = {}
+        self._runs: dict[str, RunResult] = {}
+        self._recurring: dict[str, RecurringRun] = {}
+        self._lock = threading.Lock()
+
+    # ---------------- pipelines ----------------
+
+    def upload_pipeline(self, pipe: dsl.Pipeline,
+                        name: Optional[str] = None) -> str:
+        name = name or pipe.name
+        with self._lock:
+            self._pipelines[name] = pipe
+        return name
+
+    def list_pipelines(self) -> list[str]:
+        with self._lock:
+            return sorted(self._pipelines)
+
+    # ---------------- runs ----------------
+
+    def create_run(self, pipeline: str | dsl.Pipeline,
+                   arguments: Optional[dict[str, Any]] = None,
+                   run_id: Optional[str] = None) -> RunResult:
+        pipe = (pipeline if isinstance(pipeline, dsl.Pipeline)
+                else self._pipelines[pipeline])
+        result = self.runner.run(pipe, arguments=arguments, run_id=run_id)
+        with self._lock:
+            self._runs[result.run_id] = result
+        return result
+
+    def get_run(self, run_id: str) -> Optional[RunResult]:
+        with self._lock:
+            return self._runs.get(run_id)
+
+    def list_runs(self, pipeline: Optional[str] = None) -> list[RunResult]:
+        with self._lock:
+            runs = list(self._runs.values())
+        if pipeline:
+            runs = [r for r in runs if r.run_id.startswith(pipeline)]
+        return sorted(runs, key=lambda r: r.run_id)
+
+    # ---------------- recurring runs ----------------
+
+    def create_recurring_run(self, name: str, pipeline: str,
+                             interval_seconds: float,
+                             arguments: Optional[dict[str, Any]] = None,
+                             max_concurrency: int = 1) -> RecurringRun:
+        if pipeline not in self._pipelines:
+            raise KeyError(f"unknown pipeline {pipeline!r}")
+        rr = RecurringRun(name=name, pipeline=pipeline,
+                          interval_seconds=interval_seconds,
+                          arguments=dict(arguments or {}),
+                          max_concurrency=max_concurrency)
+        with self._lock:
+            self._recurring[name] = rr
+        return rr
+
+    def disable_recurring_run(self, name: str) -> None:
+        with self._lock:
+            self._recurring[name].enabled = False
+
+    def tick(self, now: Optional[float] = None) -> list[RunResult]:
+        """Fire due recurring runs (the scheduled-workflow controller's
+        reconcile step; call from a timer loop in production)."""
+        now = time.time() if now is None else now
+        fired = []
+        with self._lock:
+            # claim due jobs under the lock (stamp last_fire + reserve a
+            # concurrency ticket) so concurrent ticks can't double-fire
+            due = []
+            for rr in self._recurring.values():
+                if (rr.enabled
+                        and now - rr.last_fire >= rr.interval_seconds
+                        and rr._inflight < rr.max_concurrency):
+                    rr.last_fire = now
+                    rr._inflight += 1
+                    due.append(rr)
+        for rr in due:
+            try:
+                result = self.create_run(
+                    rr.pipeline, arguments=rr.arguments,
+                    run_id=f"{rr.pipeline}-{rr.name}-{int(now)}")
+            finally:
+                with self._lock:
+                    rr._inflight -= 1
+            with self._lock:
+                rr.run_ids.append(result.run_id)
+            fired.append(result)
+        return fired

@@ -1,0 +1,113 @@
+"""Pipeline compiler — DSL graph → IR document (YAML).
+
+The kfp Compiler equivalent (SURVEY.md §2.5: 'Python DSL ... compiler → IR =
+PipelineSpec proto'; §4.4 golden-file tests are the test pattern). The IR is
+a plain YAML document (no proto toolchain here) with the same information
+content: components, dag tasks, parameter/artifact wiring, trigger
+conditions, iterators, exit handlers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import yaml
+
+from kubeflow_tpu.pipelines import dsl
+
+IR_SCHEMA_VERSION = "kubeflow-tpu-ir/v1"
+
+
+def _encode_value(v: Any) -> dict:
+    if isinstance(v, dsl.OutputRef):
+        return {"taskOutput": {"task": v.task, "output": v.output}}
+    if isinstance(v, dsl.ParamRef):
+        return {"pipelineParameter": v.name}
+    if isinstance(v, dsl.LoopItemRef):
+        d: dict[str, Any] = {"loopItem": v.loop_id}
+        if v.field:
+            d["field"] = v.field
+        return d
+    if isinstance(v, dsl.Task):
+        raise TypeError(
+            f"task {v.name!r} passed directly as an argument; pass "
+            f"task.output or task.outputs['name']")
+    return {"constant": v}
+
+
+def _encode_condition(expr: dsl.ConditionExpr) -> dict:
+    return {"lhs": _encode_value(expr.lhs), "op": expr.op,
+            "rhs": _encode_value(expr.rhs)}
+
+
+def compile_pipeline(pipe: dsl.Pipeline) -> dict:
+    """Lower a pipeline to its IR dict (trace with symbolic parameters)."""
+    ctx = pipe.trace()
+    components: dict[str, dict] = {}
+    tasks: dict[str, dict] = {}
+
+    for task in ctx.tasks.values():
+        spec = task.component.spec
+        comp_key = f"comp-{spec.name}"
+        if comp_key not in components:
+            components[comp_key] = {
+                "name": spec.name,
+                "inputs": dict(spec.inputs),
+                "outputArtifacts": dict(spec.output_artifacts),
+                "returnOutput": spec.return_output,
+                "retries": spec.retries,
+                "cacheEnabled": spec.cache_enabled,
+                "fnRef": f"{spec.fn.__module__}:{spec.fn.__qualname__}",
+            }
+        t: dict[str, Any] = {
+            "componentRef": comp_key,
+            "inputs": {k: _encode_value(v)
+                       for k, v in sorted(task.arguments.items())},
+        }
+        deps = sorted(set(task.dependencies))
+        if deps:
+            t["dependentTasks"] = deps
+        if task.condition is not None:
+            t["triggerCondition"] = _encode_condition(task.condition)
+        if task.loop is not None:
+            t["iterator"] = {
+                "loopId": task.loop.loop_id,
+                "items": _encode_value(task.loop.items),
+            }
+        if task.is_exit_handler:
+            t["exitHandler"] = True
+        tasks[task.name] = t
+
+    return {
+        "schemaVersion": IR_SCHEMA_VERSION,
+        "pipelineInfo": {"name": pipe.name},
+        "root": {
+            "inputDefinitions": {
+                "parameters": {
+                    k: ({"defaultValue": v} if v is not None else {})
+                    for k, v in pipe.spec.params.items()
+                }
+            },
+            "dag": {"tasks": tasks},
+        },
+        "components": components,
+    }
+
+
+class Compiler:
+    """kfp-compatible surface: Compiler().compile(pipeline, path)."""
+
+    def compile(self, pipe: dsl.Pipeline, package_path: str) -> dict:
+        ir = compile_pipeline(pipe)
+        with open(package_path, "w") as f:
+            yaml.safe_dump(ir, f, sort_keys=True)
+        return ir
+
+
+def load_ir(path: str) -> dict:
+    with open(path) as f:
+        ir = yaml.safe_load(f)
+    if ir.get("schemaVersion") != IR_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported IR schema {ir.get('schemaVersion')!r}")
+    return ir

@@ -1,0 +1,391 @@
+"""Pipelines DSL — the kfp.dsl equivalent (SURVEY.md §2.5: @dsl.component,
+@dsl.pipeline, Condition/ParallelFor/ExitHandler, artifact types).
+
+Authoring model is the same as the reference: calling a @component inside a
+@pipeline function doesn't execute it — it records a Task in the active
+pipeline graph; the compiler then lowers the graph to IR. Artifacts pass by
+file path (Input[X]/Output[X] annotations), parameters pass by value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import typing
+from typing import Any, Callable, Generic, Optional, TypeVar
+
+
+# ------------------------------------------------------------- artifacts ----
+
+class Artifact:
+    """Base artifact: a named, typed file/directory plus metadata."""
+
+    TYPE = "system.Artifact"
+
+    def __init__(self, uri: str = "", name: str = ""):
+        self.uri = uri
+        self.name = name
+        self.metadata: dict[str, Any] = {}
+
+    @property
+    def path(self) -> str:
+        return self.uri
+
+
+class Dataset(Artifact):
+    TYPE = "system.Dataset"
+
+
+class Model(Artifact):
+    TYPE = "system.Model"
+
+
+class Metrics(Artifact):
+    TYPE = "system.Metrics"
+
+    def log_metric(self, name: str, value: float) -> None:
+        self.metadata[name] = float(value)
+
+
+ARTIFACT_TYPES = {c.TYPE: c for c in (Artifact, Dataset, Model, Metrics)}
+
+T = TypeVar("T", bound=Artifact)
+
+
+class Input(Generic[T]):
+    """Annotation marker: ``x: Input[Dataset]``."""
+
+
+class Output(Generic[T]):
+    """Annotation marker: ``x: Output[Model]``."""
+
+
+def _annotation_kind(ann: Any) -> tuple[str, Optional[type]]:
+    """Classify a parameter annotation: ('input_artifact', Dataset),
+    ('output_artifact', Model) or ('parameter', None)."""
+    origin = typing.get_origin(ann)
+    if origin in (Input, Output):
+        (art,) = typing.get_args(ann)
+        kind = "input_artifact" if origin is Input else "output_artifact"
+        return kind, art
+    return "parameter", None
+
+
+# ------------------------------------------------------------ components ----
+
+@dataclasses.dataclass
+class ComponentSpec:
+    name: str
+    fn: Callable
+    inputs: dict[str, str]            # param name -> 'parameter'|artifact TYPE
+    output_artifacts: dict[str, str]  # param name -> artifact TYPE
+    return_output: bool               # fn returns a value => 'Output' param
+    defaults: dict[str, Any]
+    retries: int = 0
+    cache_enabled: bool = True
+
+
+class Component:
+    """A wrapped component function. Calling it inside a pipeline context
+    records a Task; calling it outside raises (use .execute for direct
+    invocation in tests)."""
+
+    def __init__(self, spec: ComponentSpec):
+        self.spec = spec
+        self.name = spec.name
+
+    def __call__(self, **kwargs: Any) -> "Task":
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            raise RuntimeError(
+                f"component {self.name!r} called outside a pipeline; "
+                f"use {self.name}.spec.fn(...) to run the raw function")
+        return ctx.add_task(self, kwargs)
+
+    def set_retries(self, retries: int) -> "Component":
+        self.spec.retries = retries
+        return self
+
+    def set_caching(self, enabled: bool) -> "Component":
+        self.spec.cache_enabled = enabled
+        return self
+
+
+def component(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+              retries: int = 0, cache: bool = True):
+    """Decorator turning a python function into a pipeline component."""
+
+    def wrap(f: Callable) -> Component:
+        hints = typing.get_type_hints(f, include_extras=True)
+        sig = inspect.signature(f)
+        inputs: dict[str, str] = {}
+        output_artifacts: dict[str, str] = {}
+        defaults: dict[str, Any] = {}
+        for pname, p in sig.parameters.items():
+            ann = hints.get(pname, Any)
+            kind, art = _annotation_kind(ann)
+            if kind == "input_artifact":
+                inputs[pname] = art.TYPE
+            elif kind == "output_artifact":
+                output_artifacts[pname] = art.TYPE
+            else:
+                inputs[pname] = "parameter"
+                if p.default is not inspect.Parameter.empty:
+                    defaults[pname] = p.default
+        # `-> None` means no output (get_type_hints maps it to NoneType)
+        returns = hints.get("return", None) not in (None, type(None))
+        spec = ComponentSpec(
+            name=name or f.__name__, fn=f, inputs=inputs,
+            output_artifacts=output_artifacts, return_output=returns,
+            defaults=defaults, retries=retries, cache_enabled=cache)
+        return Component(spec)
+
+    return wrap(fn) if fn is not None else wrap
+
+
+# ----------------------------------------------------------- references ----
+
+@dataclasses.dataclass(frozen=True)
+class OutputRef:
+    """Reference to a task's named output, usable as another task's input
+    or in a Condition."""
+
+    task: str
+    output: str                     # 'Output' for the return value
+
+    def __eq__(self, other):        # builds a ConditionExpr, not a bool
+        return ConditionExpr(self, "==", other)
+
+    def __ne__(self, other):
+        return ConditionExpr(self, "!=", other)
+
+    def __gt__(self, other):
+        return ConditionExpr(self, ">", other)
+
+    def __ge__(self, other):
+        return ConditionExpr(self, ">=", other)
+
+    def __lt__(self, other):
+        return ConditionExpr(self, "<", other)
+
+    def __le__(self, other):
+        return ConditionExpr(self, "<=", other)
+
+    def __hash__(self):
+        return hash((self.task, self.output))
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamRef:
+    """Reference to a pipeline-level input parameter."""
+
+    name: str
+
+    def __eq__(self, other):
+        return ConditionExpr(self, "==", other)
+
+    def __ne__(self, other):
+        return ConditionExpr(self, "!=", other)
+
+    def __gt__(self, other):
+        return ConditionExpr(self, ">", other)
+
+    def __ge__(self, other):
+        return ConditionExpr(self, ">=", other)
+
+    def __lt__(self, other):
+        return ConditionExpr(self, "<", other)
+
+    def __le__(self, other):
+        return ConditionExpr(self, "<=", other)
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopItemRef:
+    """The current item inside a ParallelFor body (or a field of it)."""
+
+    loop_id: str
+    field: Optional[str] = None
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return LoopItemRef(self.loop_id, item)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConditionExpr:
+    lhs: Any
+    op: str
+    rhs: Any
+
+
+# ---------------------------------------------------------------- tasks ----
+
+@dataclasses.dataclass
+class Task:
+    name: str
+    component: Component
+    arguments: dict[str, Any]
+    dependencies: list[str] = dataclasses.field(default_factory=list)
+    condition: Optional[ConditionExpr] = None
+    loop: Optional["ParallelFor"] = None      # enclosing loop, if any
+    is_exit_handler: bool = False
+
+    @property
+    def output(self) -> OutputRef:
+        if not self.component.spec.return_output:
+            raise AttributeError(
+                f"component {self.component.name!r} has no return value")
+        return OutputRef(self.name, "Output")
+
+    @property
+    def outputs(self) -> dict[str, OutputRef]:
+        refs = {k: OutputRef(self.name, k)
+                for k in self.component.spec.output_artifacts}
+        if self.component.spec.return_output:
+            refs["Output"] = OutputRef(self.name, "Output")
+        return refs
+
+    def after(self, *tasks: "Task") -> "Task":
+        self.dependencies.extend(t.name for t in tasks)
+        return self
+
+
+# --------------------------------------------------------- control flow ----
+
+class _PipelineContext:
+    _stack: list["_PipelineContext"] = []
+
+    def __init__(self, name: str, params: dict[str, Any]):
+        self.name = name
+        self.params = params
+        self.tasks: dict[str, Task] = {}
+        self._cond_stack: list[ConditionExpr] = []
+        self._loop_stack: list[ParallelFor] = []
+        self._exit_stack: list[str] = []   # exit-handler task names
+        self._names: dict[str, int] = {}
+
+    @classmethod
+    def current(cls) -> Optional["_PipelineContext"]:
+        return cls._stack[-1] if cls._stack else None
+
+    def __enter__(self):
+        _PipelineContext._stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _PipelineContext._stack.pop()
+
+    def add_task(self, comp: Component, args: dict[str, Any]) -> Task:
+        n = self._names.get(comp.name, 0)
+        self._names[comp.name] = n + 1
+        tname = comp.name if n == 0 else f"{comp.name}-{n + 1}"
+        task = Task(name=tname, component=comp, arguments=dict(args))
+        if self._cond_stack:
+            task.condition = self._cond_stack[-1]
+        if self._loop_stack:
+            task.loop = self._loop_stack[-1]
+        self.tasks[tname] = task
+        return task
+
+
+class Condition:
+    """``with Condition(task.output > 0.9):`` — tasks inside run only when
+    the expression holds at runtime."""
+
+    def __init__(self, expr: ConditionExpr):
+        if not isinstance(expr, ConditionExpr):
+            raise TypeError(
+                "Condition needs an expression built from a task output or "
+                "pipeline parameter (e.g. t.output > 0.5)")
+        self.expr = expr
+
+    def __enter__(self):
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            raise RuntimeError("Condition used outside a pipeline")
+        ctx._cond_stack.append(self.expr)
+        return self
+
+    def __exit__(self, *exc):
+        _PipelineContext.current()._cond_stack.pop()
+
+
+class ParallelFor:
+    """``with ParallelFor(items) as item:`` — the body fans out per item at
+    runtime. ``items`` is a static list or an upstream output reference."""
+
+    _ids = 0
+
+    def __init__(self, items: Any):
+        ParallelFor._ids += 1
+        self.loop_id = f"loop-{ParallelFor._ids}"
+        self.items = items
+
+    def __enter__(self) -> LoopItemRef:
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            raise RuntimeError("ParallelFor used outside a pipeline")
+        ctx._loop_stack.append(self)
+        return LoopItemRef(self.loop_id)
+
+    def __exit__(self, *exc):
+        _PipelineContext.current()._loop_stack.pop()
+
+
+class ExitHandler:
+    """``with ExitHandler(cleanup_task):`` — the handler task runs at
+    pipeline end regardless of failure (the reference's Argo exit handler)."""
+
+    def __init__(self, handler: Task):
+        self.handler = handler
+        handler.is_exit_handler = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+# ------------------------------------------------------------- pipeline ----
+
+@dataclasses.dataclass
+class PipelineSpec:
+    name: str
+    fn: Callable
+    params: dict[str, Any]            # name -> default
+
+
+class Pipeline:
+    def __init__(self, spec: PipelineSpec):
+        self.spec = spec
+        self.name = spec.name
+
+    def trace(self, arguments: Optional[dict[str, Any]] = None
+              ) -> _PipelineContext:
+        """Execute the pipeline function to build the task graph. Pipeline
+        parameters become ParamRefs so the graph stays symbolic."""
+        args = dict(self.spec.params)
+        args.update(arguments or {})
+        ctx = _PipelineContext(self.name, args)
+        with ctx:
+            self.spec.fn(**{k: ParamRef(k) for k in self.spec.params})
+        return ctx
+
+
+def pipeline(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    def wrap(f: Callable) -> Pipeline:
+        sig = inspect.signature(f)
+        params = {}
+        for pname, p in sig.parameters.items():
+            params[pname] = (None if p.default is inspect.Parameter.empty
+                             else p.default)
+        return Pipeline(PipelineSpec(name=name or f.__name__, fn=f,
+                                     params=params))
+
+    return wrap(fn) if fn is not None else wrap

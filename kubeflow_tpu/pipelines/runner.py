@@ -1,0 +1,493 @@
+"""Pipeline runner — DAG execution with caching, lineage, retries.
+
+The reference splits this across Argo (DAG walk), the KFP v2 driver (input
+resolution + cache check), the launcher (artifact IO + MLMD recording), and
+the cache server (SURVEY.md §2.5, §3.4). Here those roles are one runner
+with the same behaviors, executing over threads locally; the metadata
+backend is pluggable (in-proc store or the native C++ server).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import enum
+import hashlib
+import inspect
+import json
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from kubeflow_tpu.metadata import INPUT, OUTPUT, MetadataStore
+from kubeflow_tpu.pipelines import dsl
+
+
+class TaskState(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SKIPPED = "Skipped"
+    CACHED = "Cached"
+
+
+@dataclasses.dataclass
+class TaskResult:
+    name: str
+    state: TaskState = TaskState.PENDING
+    outputs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    error: str = ""
+    attempts: int = 0
+    execution_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RunResult:
+    run_id: str
+    state: TaskState
+    tasks: dict[str, TaskResult]
+    params: dict[str, Any]
+    context_id: Optional[int] = None
+
+    def task(self, name: str) -> TaskResult:
+        return self.tasks[name]
+
+    @property
+    def succeeded(self) -> bool:
+        return self.state == TaskState.SUCCEEDED
+
+
+class _Skip(Exception):
+    pass
+
+
+class LocalRunner:
+    """Executes a traced pipeline graph. ``workdir`` holds artifacts and the
+    execution cache; ``metadata`` records lineage."""
+
+    def __init__(self, workdir: str, metadata=None, max_workers: int = 8):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.metadata = metadata if metadata is not None else MetadataStore()
+        self.max_workers = max_workers
+        self.cache_dir = os.path.join(self.workdir, "_cache")
+        os.makedirs(self.cache_dir, exist_ok=True)
+
+    # ------------------------------------------------------------ run ----
+
+    def run(self, pipe: dsl.Pipeline,
+            arguments: Optional[dict[str, Any]] = None,
+            run_id: Optional[str] = None) -> RunResult:
+        args = {k: v for k, v in pipe.spec.params.items() if v is not None}
+        args.update(arguments or {})
+        missing = [k for k in pipe.spec.params if k not in args]
+        if missing:
+            raise ValueError(f"missing pipeline arguments: {missing}")
+
+        ctx = pipe.trace()
+        run_id = run_id or f"{pipe.name}-{uuid.uuid4().hex[:8]}"
+        run_dir = os.path.join(self.workdir, run_id)
+        os.makedirs(run_dir, exist_ok=True)
+        context_id = self.metadata.put_context(
+            "pipeline_run", run_id, properties={"pipeline": pipe.name})
+
+        # expand ParallelFor groups into per-item task instances
+        tasks, loop_of = self._expand(ctx, args)
+
+        results = {name: TaskResult(name=name) for name in tasks}
+        lock = threading.Lock()
+        run_failed = threading.Event()
+
+        main = {n: t for n, t in tasks.items() if not t.is_exit_handler}
+        handlers = {n: t for n, t in tasks.items() if t.is_exit_handler}
+
+        self._execute_dag(main, results, args, ctx, run_dir, context_id,
+                          lock, run_failed, loop_of)
+        # exit handlers always run, even after failure
+        self._execute_dag(handlers, results, args, ctx, run_dir, context_id,
+                          lock, threading.Event(), loop_of)
+
+        state = TaskState.FAILED if run_failed.is_set() else TaskState.SUCCEEDED
+        return RunResult(run_id=run_id, state=state, tasks=results,
+                         params=args, context_id=context_id)
+
+    # ------------------------------------------------- loop expansion ----
+
+    def _expand(self, ctx: dsl._PipelineContext, args: dict
+                ) -> tuple[dict[str, dsl.Task], dict[str, tuple[str, Any]]]:
+        """Fan ParallelFor bodies out per item. Returns (tasks, loop_of)
+        where loop_of maps expanded task name -> (loop_id, item)."""
+        tasks: dict[str, dsl.Task] = {}
+        loop_of: dict[str, tuple[str, Any]] = {}
+        loops: dict[str, list[dsl.Task]] = {}
+        for t in ctx.tasks.values():
+            if t.loop is None:
+                tasks[t.name] = t
+            else:
+                loops.setdefault(t.loop.loop_id, []).append(t)
+
+        # a task OUTSIDE a loop referencing a loop member has no single
+        # instance to bind to — needs a dynamic collect step (not yet built);
+        # fail at expansion with a clear message instead of a runtime race
+        loop_member_names = {m.name for ms in loops.values() for m in ms}
+        for t in tasks.values():
+            refs = [v for v in t.arguments.values()
+                    if isinstance(v, dsl.OutputRef)]
+            if t.condition is not None:
+                refs += [s for s in (t.condition.lhs, t.condition.rhs)
+                         if isinstance(s, dsl.OutputRef)]
+            for r in refs:
+                if r.task in loop_member_names:
+                    raise NotImplementedError(
+                        f"task {t.name!r} consumes output of ParallelFor "
+                        f"member {r.task!r}; aggregating over a fan-out "
+                        f"requires a collect step, which is not supported "
+                        f"yet")
+
+        for loop_id, members in loops.items():
+            loop = members[0].loop
+            items = loop.items
+            if isinstance(items, dsl.ParamRef):
+                items = args[items.name]
+            elif isinstance(items, dsl.OutputRef):
+                raise NotImplementedError(
+                    "ParallelFor over a task output requires the dynamic "
+                    "driver; use a pipeline parameter or static list")
+            member_names = {m.name for m in members}
+            for i, item in enumerate(items):
+                for m in members:
+                    inst_name = f"{m.name}[{i}]"
+                    inst = dsl.Task(
+                        name=inst_name, component=m.component,
+                        arguments=dict(m.arguments),
+                        dependencies=[
+                            # intra-loop deps bind within the iteration
+                            (f"{d}[{i}]" if d in member_names else d)
+                            for d in m.dependencies
+                        ],
+                        condition=m.condition, loop=m.loop,
+                        is_exit_handler=m.is_exit_handler)
+                    tasks[inst_name] = inst
+                    loop_of[inst_name] = (loop_id, item)
+        return tasks, loop_of
+
+    # ------------------------------------------------------ dag walk ----
+
+    def _execute_dag(self, tasks, results, args, ctx, run_dir, context_id,
+                     lock, run_failed, loop_of):
+        if not tasks:
+            return
+        remaining = dict(tasks)
+        with concurrent.futures.ThreadPoolExecutor(self.max_workers) as pool:
+            futures: dict[concurrent.futures.Future, str] = {}
+            while remaining or futures:
+                ready = [
+                    n for n, t in remaining.items()
+                    if all(results[d].state in (TaskState.SUCCEEDED,
+                                                TaskState.CACHED,
+                                                TaskState.SKIPPED,
+                                                TaskState.FAILED)
+                           for d in self._deps(t, tasks, ctx, loop_of))
+                ]
+                for n in ready:
+                    t = remaining.pop(n)
+                    futures[pool.submit(
+                        self._run_task, t, results, args, ctx, run_dir,
+                        context_id, lock, run_failed, loop_of)] = n
+                if not futures:
+                    if remaining:    # dependency cycle or unresolvable
+                        for n in remaining:
+                            results[n].state = TaskState.SKIPPED
+                            results[n].error = "unreachable (cycle?)"
+                        run_failed.set()
+                    return
+                done, _ = concurrent.futures.wait(
+                    futures, return_when=concurrent.futures.FIRST_COMPLETED)
+                for f in done:
+                    futures.pop(f)
+                    f.result()       # propagate runner bugs loudly
+
+    def _deps(self, task: dsl.Task, tasks, ctx, loop_of) -> set[str]:
+        """Explicit deps + data deps from argument references."""
+        deps = set(task.dependencies)
+        loop_item = loop_of.get(task.name)
+        for v in task.arguments.values():
+            if isinstance(v, dsl.OutputRef):
+                deps.add(self._ref_instance(v.task, task, tasks, loop_item))
+        expr = task.condition
+        if expr is not None:
+            for side in (expr.lhs, expr.rhs):
+                if isinstance(side, dsl.OutputRef):
+                    deps.add(self._ref_instance(side.task, task, tasks,
+                                                loop_item))
+        return {d for d in deps if d in tasks}
+
+    @staticmethod
+    def _ref_instance(ref_task: str, task: dsl.Task, tasks,
+                      loop_item) -> str:
+        """Inside loop iteration i, references to loop members bind to the
+        same iteration's instance."""
+        if loop_item is not None and task.name.endswith("]"):
+            idx = task.name[task.name.rfind("["):]
+            if f"{ref_task}{idx}" in tasks:
+                return f"{ref_task}{idx}"
+        return ref_task
+
+    # ----------------------------------------------------- task exec ----
+
+    def _run_task(self, task, results, args, ctx, run_dir, context_id,
+                  lock, run_failed, loop_of):
+        result = results[task.name]
+        try:
+            self._run_task_inner(task, results, args, run_dir, context_id,
+                                 lock, run_failed, loop_of, result)
+        except _Skip as s:
+            result.state = TaskState.SKIPPED
+            result.error = str(s)
+        except Exception as e:
+            result.state = TaskState.FAILED
+            result.error = f"{type(e).__name__}: {e}"
+            run_failed.set()
+
+    def _run_task_inner(self, task, results, args, run_dir, context_id,
+                        lock, run_failed, loop_of, result):
+        spec = task.component.spec
+        loop_item = loop_of.get(task.name)
+
+        # upstream failure/skip propagation
+        for d in self._deps(task, results, None, loop_of):
+            if results[d].state in (TaskState.FAILED, TaskState.SKIPPED):
+                raise _Skip(f"upstream {d} {results[d].state.value.lower()}")
+        if run_failed.is_set() and not task.is_exit_handler:
+            raise _Skip("run already failed")
+
+        resolve = lambda v: self._resolve(v, results, args, task, loop_of)
+        if task.condition is not None:
+            if not self._eval_condition(task.condition, resolve):
+                raise _Skip("condition false")
+
+        # resolve inputs
+        kwargs: dict[str, Any] = {}
+        input_artifacts: dict[str, dsl.Artifact] = {}
+        for pname, kind in spec.inputs.items():
+            if pname in spec.output_artifacts:
+                continue
+            if kind == "parameter":
+                if pname in task.arguments:
+                    kwargs[pname] = resolve(task.arguments[pname])
+                elif pname in spec.defaults:
+                    kwargs[pname] = spec.defaults[pname]
+                else:
+                    raise TypeError(
+                        f"{task.name}: missing argument {pname!r}")
+            else:
+                art = resolve(task.arguments[pname])
+                if not isinstance(art, dsl.Artifact):
+                    raise TypeError(
+                        f"{task.name}: input {pname!r} expects an artifact")
+                kwargs[pname] = art
+                input_artifacts[pname] = art
+
+        # cache check
+        fingerprint = self._fingerprint(spec, kwargs, input_artifacts)
+        if spec.cache_enabled:
+            cached = self._cache_lookup(fingerprint)
+            if cached is not None:
+                result.outputs = cached
+                result.state = TaskState.CACHED
+                self._record(task, context_id, kwargs, input_artifacts,
+                             cached, "CACHED", result)
+                return
+
+        # create output artifacts
+        task_dir = os.path.join(run_dir, task.name.replace("/", "_"))
+        os.makedirs(task_dir, exist_ok=True)
+        for oname, otype in spec.output_artifacts.items():
+            cls = dsl.ARTIFACT_TYPES.get(otype, dsl.Artifact)
+            kwargs[oname] = cls(
+                uri=os.path.join(task_dir, oname), name=oname)
+
+        # execute with retries
+        result.state = TaskState.RUNNING
+        last_err: Optional[Exception] = None
+        for attempt in range(spec.retries + 1):
+            result.attempts = attempt + 1
+            try:
+                ret = spec.fn(**kwargs)
+                last_err = None
+                break
+            except Exception as e:
+                last_err = e
+        if last_err is not None:
+            self._record(task, context_id, kwargs, input_artifacts, {},
+                         "FAILED", result)
+            raise last_err
+
+        outputs: dict[str, Any] = {
+            oname: kwargs[oname] for oname in spec.output_artifacts}
+        if spec.return_output:
+            outputs["Output"] = ret
+        result.outputs = outputs
+        result.state = TaskState.SUCCEEDED
+        if spec.cache_enabled:
+            self._cache_put(fingerprint, outputs)
+        self._record(task, context_id, kwargs, input_artifacts, outputs,
+                     "COMPLETE", result)
+
+    # ---------------------------------------------------- resolution ----
+
+    def _resolve(self, v, results, args, task, loop_of):
+        if isinstance(v, dsl.ParamRef):
+            return args[v.name]
+        if isinstance(v, dsl.OutputRef):
+            inst = self._ref_instance(v.task, task, results,
+                                      loop_of.get(task.name))
+            dep = results[inst]
+            if v.output not in dep.outputs:
+                raise KeyError(
+                    f"task {inst!r} has no output {v.output!r}")
+            return dep.outputs[v.output]
+        if isinstance(v, dsl.LoopItemRef):
+            loop_item = loop_of.get(task.name)
+            if loop_item is None or loop_item[0] != v.loop_id:
+                raise RuntimeError(
+                    f"{task.name}: loop item reference outside its loop")
+            item = loop_item[1]
+            return item[v.field] if v.field else item
+        return v
+
+    def _eval_condition(self, expr: dsl.ConditionExpr, resolve) -> bool:
+        lhs, rhs = resolve(expr.lhs), resolve(expr.rhs)
+        return {
+            "==": lambda: lhs == rhs,
+            "!=": lambda: lhs != rhs,
+            ">": lambda: lhs > rhs,
+            ">=": lambda: lhs >= rhs,
+            "<": lambda: lhs < rhs,
+            "<=": lambda: lhs <= rhs,
+        }[expr.op]()
+
+    # -------------------------------------------------------- cache ----
+
+    def _fingerprint(self, spec, kwargs, input_artifacts) -> str:
+        h = hashlib.sha256()
+        h.update(spec.name.encode())
+        try:
+            h.update(inspect.getsource(spec.fn).encode())
+        except OSError:
+            h.update(repr(spec.fn).encode())
+        for k in sorted(kwargs):
+            v = kwargs[k]
+            if isinstance(v, dsl.Artifact):
+                h.update(f"{k}:artifact:".encode())
+                h.update(self._artifact_digest(v))
+            else:
+                h.update(f"{k}:{json.dumps(v, sort_keys=True, default=repr)}"
+                         .encode())
+        return h.hexdigest()
+
+    @staticmethod
+    def _artifact_digest(art: dsl.Artifact) -> bytes:
+        h = hashlib.sha256()
+        if os.path.isfile(art.uri):
+            with open(art.uri, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+        elif os.path.isdir(art.uri):
+            for root, _, files in sorted(os.walk(art.uri)):
+                for fname in sorted(files):
+                    p = os.path.join(root, fname)
+                    h.update(fname.encode())
+                    with open(p, "rb") as f:
+                        h.update(f.read())
+        h.update(json.dumps(art.metadata, sort_keys=True).encode())
+        return h.digest()
+
+    def _cache_lookup(self, fingerprint: str) -> Optional[dict[str, Any]]:
+        entry = os.path.join(self.cache_dir, fingerprint)
+        meta_path = os.path.join(entry, "outputs.json")
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            meta = json.load(f)
+        outputs: dict[str, Any] = {}
+        for name, rec in meta.items():
+            if rec["kind"] == "artifact":
+                cls = dsl.ARTIFACT_TYPES.get(rec["type"], dsl.Artifact)
+                art = cls(uri=os.path.join(entry, name), name=name)
+                art.metadata = rec.get("metadata", {})
+                outputs[name] = art
+            else:
+                outputs[name] = rec["value"]
+        return outputs
+
+    def _cache_put(self, fingerprint: str, outputs: dict[str, Any]) -> None:
+        entry = os.path.join(self.cache_dir, fingerprint)
+        tmp = entry + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        meta: dict[str, Any] = {}
+        for name, v in outputs.items():
+            if isinstance(v, dsl.Artifact):
+                dest = os.path.join(tmp, name)
+                if os.path.isdir(v.uri):
+                    shutil.copytree(v.uri, dest)
+                elif os.path.isfile(v.uri):
+                    shutil.copy2(v.uri, dest)
+                meta[name] = {"kind": "artifact", "type": type(v).TYPE,
+                              "metadata": v.metadata}
+            else:
+                try:
+                    json.dumps(v)
+                except TypeError:
+                    continue        # unserializable return: don't cache it
+                meta[name] = {"kind": "value", "value": v}
+        with open(os.path.join(tmp, "outputs.json"), "w") as f:
+            json.dump(meta, f)
+        shutil.rmtree(entry, ignore_errors=True)
+        try:
+            os.replace(tmp, entry)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)   # concurrent writer won
+
+    # ----------------------------------------------------- metadata ----
+
+    def _record(self, task, context_id, kwargs, input_artifacts, outputs,
+                state, result) -> None:
+        spec = task.component.spec
+        eid = self.metadata.put_execution(
+            type=spec.name, name=task.name, state=state,
+            properties={k: v for k, v in kwargs.items()
+                        if not isinstance(v, dsl.Artifact)
+                        and _jsonable(v)})
+        result.execution_id = eid
+        self.metadata.associate(context_id, eid)
+        for pname, art in input_artifacts.items():
+            aid = getattr(art, "_mlmd_id", None)
+            if aid is None:
+                aid = self.metadata.put_artifact(
+                    type=type(art).TYPE, uri=art.uri, name=art.name,
+                    properties=art.metadata)
+                art._mlmd_id = aid
+            self.metadata.put_event(eid, aid, INPUT, path=pname)
+        for oname, v in outputs.items():
+            if not isinstance(v, dsl.Artifact):
+                continue
+            aid = self.metadata.put_artifact(
+                type=type(v).TYPE, uri=v.uri, name=v.name,
+                properties=v.metadata)
+            v._mlmd_id = aid
+            self.metadata.put_event(eid, aid, OUTPUT, path=oname)
+            self.metadata.attribute(context_id, aid)
+
+
+def _jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except TypeError:
+        return False

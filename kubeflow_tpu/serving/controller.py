@@ -74,12 +74,28 @@ class ServingController:
         if existing is None:
             isvc.generation = 1
             self.services[key] = isvc
+        elif self._spec_equal(existing, isvc):
+            # idempotent re-apply: no generation bump, no new revision
+            isvc.generation = existing.generation
+            isvc.status = existing.status
+            self.services[key] = isvc
         else:
             isvc.generation = existing.generation + 1
             isvc.status = existing.status
             self.services[key] = isvc
         self.reconcile(isvc.namespace, isvc.name)
         return isvc
+
+    @staticmethod
+    def _spec_equal(a: InferenceService, b: InferenceService) -> bool:
+        import dataclasses as dc
+
+        def norm(v):
+            return dc.asdict(v) if dc.is_dataclass(v) else v
+
+        return all(norm(getattr(a, f)) == norm(getattr(b, f))
+                   for f in ("predictor", "transformer", "explainer",
+                             "labels"))
 
     def get(self, namespace: str, name: str) -> Optional[InferenceService]:
         return self.services.get((namespace, name))

@@ -172,14 +172,21 @@ class LLMModel(Model):
             top_p=float(p.get("top_p", 1.0)),
             eos_id=(int(p["eos_id"]) if "eos_id" in p else None),
         )
+        prompts = []
+        for row in ids:
+            prompt = [int(t) for t in row]
+            # strip only TRAILING padding — pad_id may be a real token
+            # elsewhere in the sequence
+            while prompt and prompt[-1] == self.pad_id:
+                prompt.pop()
+            prompts.append(prompt)
+        # validate EVERY row before enqueuing ANY: a mid-batch rejection must
+        # not leave earlier rows generating with no caller to collect them
+        for prompt in prompts:
+            self.engine.validate_prompt(prompt)
         reqs = []
         with self._wake:
-            for row in ids:
-                prompt = [int(t) for t in row]
-                # strip only TRAILING padding — pad_id may be a real token
-                # elsewhere in the sequence
-                while prompt and prompt[-1] == self.pad_id:
-                    prompt.pop()
+            for prompt in prompts:
                 reqs.append(self.engine.add_request(prompt, sampling))
             self._wake.notify_all()
         with self._wake:

@@ -141,8 +141,9 @@ class LLMEngine:
 
     # ---------------- public API ----------------
 
-    def add_request(self, prompt: Sequence[int],
-                    sampling: Optional[SamplingParams] = None) -> GenRequest:
+    def validate_prompt(self, prompt: Sequence[int]) -> None:
+        """Raise if the prompt can't be served. Called by add_request; also
+        callable up front to vet a whole batch before enqueuing any of it."""
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + 1 > self.max_seq:
@@ -153,6 +154,10 @@ class LLMEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds largest prefill "
                 f"bucket {self.buckets[-1]}")
+
+    def add_request(self, prompt: Sequence[int],
+                    sampling: Optional[SamplingParams] = None) -> GenRequest:
+        self.validate_prompt(prompt)
         req = GenRequest(id=next(self._ids), prompt=list(map(int, prompt)),
                          sampling=sampling or SamplingParams())
         with self._lock:

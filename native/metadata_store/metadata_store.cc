@@ -1,0 +1,700 @@
+// Native metadata/lineage server — the C++ MLMD-equivalent (SURVEY.md §2.5:
+// ml-metadata is the reference stack's one C++ gRPC service).
+//
+// Data model: Artifacts / Executions / Contexts with JSON property maps,
+// Events (INPUT/OUTPUT) linking executions to artifacts, Associations /
+// Attributions linking contexts. Lineage queries walk events.
+//
+// Wire protocol: length-prefixed JSON over TCP (4-byte big-endian length +
+// UTF-8 JSON body), matching kubeflow_tpu/metadata/client.py. No external
+// deps: a minimal JSON parser/serializer is included. Persistence: JSONL
+// write-ahead log, same record format the Python store writes, so the two
+// backends are interchangeable on the same WAL file.
+//
+// Build: `make` in this directory (g++ -O2 -std=c++17). Run:
+//   ./metadata_store --port 0 [--wal /path/store.wal]
+// Prints "LISTENING <port>" on stdout once bound (the launcher handshake).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------- JSON ----
+
+struct Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+struct Json {
+  enum Type { NUL, BOOL, NUM, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JsonPtr> arr;
+  std::map<std::string, JsonPtr> obj;
+
+  static JsonPtr mknull() { return std::make_shared<Json>(); }
+  static JsonPtr mkbool(bool v) {
+    auto j = std::make_shared<Json>(); j->type = BOOL; j->b = v; return j;
+  }
+  static JsonPtr mknum(double v) {
+    auto j = std::make_shared<Json>(); j->type = NUM; j->num = v; return j;
+  }
+  static JsonPtr mkstr(std::string v) {
+    auto j = std::make_shared<Json>(); j->type = STR; j->str = std::move(v);
+    return j;
+  }
+  static JsonPtr mkarr() {
+    auto j = std::make_shared<Json>(); j->type = ARR; return j;
+  }
+  static JsonPtr mkobj() {
+    auto j = std::make_shared<Json>(); j->type = OBJ; return j;
+  }
+
+  double as_num(double dflt = 0) const { return type == NUM ? num : dflt; }
+  std::string as_str(const std::string& dflt = "") const {
+    return type == STR ? str : dflt;
+  }
+  JsonPtr get(const std::string& key) const {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : it->second;
+  }
+  double num_at(const std::string& key, double dflt = 0) const {
+    auto v = get(key); return v ? v->as_num(dflt) : dflt;
+  }
+  std::string str_at(const std::string& key,
+                     const std::string& dflt = "") const {
+    auto v = get(key); return v ? v->as_str(dflt) : dflt;
+  }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& s) : s_(s) {}
+  JsonPtr parse() {
+    auto v = value();
+    ws();
+    if (pos_ != s_.size()) throw std::runtime_error("trailing json");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  void ws() {
+    while (pos_ < s_.size() && std::isspace((unsigned char)s_[pos_])) pos_++;
+  }
+  char peek() {
+    if (pos_ >= s_.size()) throw std::runtime_error("eof");
+    return s_[pos_];
+  }
+  void expect(char c) {
+    if (peek() != c) throw std::runtime_error(std::string("expected ") + c);
+    pos_++;
+  }
+  JsonPtr value() {
+    ws();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') return Json::mkstr(string());
+    if (c == 't') { lit("true"); return Json::mkbool(true); }
+    if (c == 'f') { lit("false"); return Json::mkbool(false); }
+    if (c == 'n') { lit("null"); return Json::mknull(); }
+    return number();
+  }
+  void lit(const char* w) {
+    size_t n = std::strlen(w);
+    if (s_.compare(pos_, n, w) != 0) throw std::runtime_error("bad literal");
+    pos_ += n;
+  }
+  JsonPtr object() {
+    auto j = Json::mkobj();
+    expect('{'); ws();
+    if (peek() == '}') { pos_++; return j; }
+    while (true) {
+      ws();
+      std::string key = string();
+      ws(); expect(':');
+      j->obj[key] = value();
+      ws();
+      if (peek() == ',') { pos_++; continue; }
+      expect('}');
+      return j;
+    }
+  }
+  JsonPtr array() {
+    auto j = Json::mkarr();
+    expect('['); ws();
+    if (peek() == ']') { pos_++; return j; }
+    while (true) {
+      j->arr.push_back(value());
+      ws();
+      if (peek() == ',') { pos_++; continue; }
+      expect(']');
+      return j;
+    }
+  }
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      char c = peek(); pos_++;
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = peek(); pos_++;
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) throw std::runtime_error("bad \\u");
+            unsigned cp = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // combine UTF-16 surrogate pairs (json.dumps ensure_ascii emits
+            // them for astral-plane chars); lone surrogates are an error
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (pos_ + 6 > s_.size() || s_[pos_] != '\\' ||
+                  s_[pos_ + 1] != 'u')
+                throw std::runtime_error("lone high surrogate");
+              unsigned lo = std::stoul(s_.substr(pos_ + 2, 4), nullptr, 16);
+              if (lo < 0xDC00 || lo > 0xDFFF)
+                throw std::runtime_error("bad low surrogate");
+              pos_ += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              throw std::runtime_error("lone low surrogate");
+            }
+            if (cp < 0x80) out += (char)cp;
+            else if (cp < 0x800) {
+              out += (char)(0xC0 | (cp >> 6));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += (char)(0xE0 | (cp >> 12));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else {
+              out += (char)(0xF0 | (cp >> 18));
+              out += (char)(0x80 | ((cp >> 12) & 0x3F));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+  JsonPtr number() {
+    size_t start = pos_;
+    while (pos_ < s_.size() &&
+           (std::isdigit((unsigned char)s_[pos_]) || s_[pos_] == '-' ||
+            s_[pos_] == '+' || s_[pos_] == '.' || s_[pos_] == 'e' ||
+            s_[pos_] == 'E'))
+      pos_++;
+    if (pos_ == start) throw std::runtime_error("bad number");
+    return Json::mknum(std::stod(s_.substr(start, pos_ - start)));
+  }
+};
+
+static void dump(const JsonPtr& j, std::string& out) {
+  if (!j) { out += "null"; return; }
+  switch (j->type) {
+    case Json::NUL: out += "null"; break;
+    case Json::BOOL: out += j->b ? "true" : "false"; break;
+    case Json::NUM: {
+      double d = j->num;
+      if (d == (int64_t)d && std::abs(d) < 1e15) {
+        out += std::to_string((int64_t)d);
+      } else {
+        std::ostringstream os; os.precision(17); os << d; out += os.str();
+      }
+      break;
+    }
+    case Json::STR: {
+      out += '"';
+      for (char c : j->str) {
+        switch (c) {
+          case '"': out += "\\\""; break;
+          case '\\': out += "\\\\"; break;
+          case '\n': out += "\\n"; break;
+          case '\t': out += "\\t"; break;
+          case '\r': out += "\\r"; break;
+          default:
+            if ((unsigned char)c < 0x20) {
+              char buf[8]; std::snprintf(buf, 8, "\\u%04x", c); out += buf;
+            } else out += c;
+        }
+      }
+      out += '"';
+      break;
+    }
+    case Json::ARR: {
+      out += '[';
+      for (size_t i = 0; i < j->arr.size(); i++) {
+        if (i) out += ',';
+        dump(j->arr[i], out);
+      }
+      out += ']';
+      break;
+    }
+    case Json::OBJ: {
+      out += '{';
+      bool first = true;
+      for (auto& kv : j->obj) {
+        if (!first) out += ',';
+        first = false;
+        dump(Json::mkstr(kv.first), out);
+        out += ':';
+        dump(kv.second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+static std::string dumps(const JsonPtr& j) {
+  std::string out;
+  dump(j, out);
+  return out;
+}
+
+// ---------------------------------------------------------------- store ----
+
+struct Node {                       // artifact or execution or context
+  int64_t id = 0;
+  std::string type, name, uri, state;
+  JsonPtr properties = Json::mkobj();
+};
+
+struct EventRec {
+  int64_t execution = 0, artifact = 0;
+  std::string type, path;           // INPUT | OUTPUT
+};
+
+class Store {
+ public:
+  explicit Store(const std::string& wal_path) : wal_path_(wal_path) {
+    if (!wal_path_.empty()) {
+      replay();
+      wal_file_.open(wal_path_, std::ios::app);  // one handle, kept open
+    }
+  }
+
+  JsonPtr handle(const JsonPtr& req) {
+    std::lock_guard<std::mutex> g(mu_);
+    const std::string method = req->str_at("method");
+    if (method == "PutArtifact") return put_node(artifacts_, req, "artifact");
+    if (method == "PutExecution")
+      return put_node(executions_, req, "execution");
+    if (method == "PutContext") return put_context(req);
+    if (method == "UpdateExecution") return update_execution(req);
+    if (method == "PutEvent") return put_event(req);
+    if (method == "Associate") return put_link(associations_, req,
+                                               "execution", "assoc");
+    if (method == "Attribute") return put_link(attributions_, req,
+                                               "artifact", "attr");
+    if (method == "GetArtifact") return get_node(artifacts_, req);
+    if (method == "GetExecution") return get_node(executions_, req);
+    if (method == "ContextByName") return context_by_name(req);
+    if (method == "ExecutionsInContext")
+      return in_context(associations_, executions_, req);
+    if (method == "ArtifactsInContext")
+      return in_context(attributions_, artifacts_, req);
+    if (method == "Producer") return producer(req);
+    if (method == "InputsOf") return io_of(req, "INPUT");
+    if (method == "OutputsOf") return io_of(req, "OUTPUT");
+    if (method == "UpstreamArtifacts") return lineage(req, /*up=*/true);
+    if (method == "DownstreamArtifacts") return lineage(req, /*up=*/false);
+    if (method == "Ping") {
+      auto r = Json::mkobj();
+      r->obj["ok"] = Json::mkbool(true);
+      return r;
+    }
+    return error("unknown method " + method);
+  }
+
+ private:
+  std::mutex mu_;
+  int64_t ids_ = 0;
+  std::map<int64_t, Node> artifacts_, executions_, contexts_;
+  std::vector<EventRec> events_;
+  std::vector<std::pair<int64_t, int64_t>> associations_, attributions_;
+  std::string wal_path_;
+  std::ofstream wal_file_;
+
+  static JsonPtr error(const std::string& msg) {
+    auto r = Json::mkobj();
+    r->obj["error"] = Json::mkstr(msg);
+    return r;
+  }
+  static JsonPtr ok_id(int64_t id) {
+    auto r = Json::mkobj();
+    r->obj["id"] = Json::mknum((double)id);
+    return r;
+  }
+  static JsonPtr node_json(const Node& n, const char* kind) {
+    auto r = Json::mkobj();
+    r->obj["id"] = Json::mknum((double)n.id);
+    r->obj["type"] = Json::mkstr(n.type);
+    r->obj["name"] = Json::mkstr(n.name);
+    r->obj["properties"] = n.properties;
+    if (std::string(kind) == "artifact") {
+      r->obj["uri"] = Json::mkstr(n.uri);
+      r->obj["state"] = Json::mkstr(n.state);
+    } else if (std::string(kind) == "execution") {
+      r->obj["state"] = Json::mkstr(n.state);
+    }
+    return r;
+  }
+
+  void wal(const JsonPtr& rec) {
+    if (!wal_file_.is_open()) return;
+    wal_file_ << dumps(rec) << "\n";
+    wal_file_.flush();
+  }
+
+  JsonPtr put_node(std::map<int64_t, Node>& table, const JsonPtr& req,
+                   const char* kind) {
+    Node n;
+    n.id = ++ids_;
+    n.type = req->str_at("type");
+    n.name = req->str_at("name");
+    n.uri = req->str_at("uri");
+    n.state = req->str_at("state",
+                          std::string(kind) == "artifact" ? "LIVE"
+                                                          : "RUNNING");
+    auto props = req->get("properties");
+    if (props && props->type == Json::OBJ) n.properties = props;
+    table[n.id] = n;
+    auto rec = node_json(n, kind);
+    rec->obj["op"] = Json::mkstr(kind);
+    wal(rec);
+    return ok_id(n.id);
+  }
+
+  JsonPtr put_context(const JsonPtr& req) {
+    std::string type = req->str_at("type"), name = req->str_at("name");
+    for (auto& kv : contexts_)
+      if (kv.second.type == type && kv.second.name == name)
+        return ok_id(kv.first);
+    Node n;
+    n.id = ++ids_;
+    n.type = type;
+    n.name = name;
+    auto props = req->get("properties");
+    if (props && props->type == Json::OBJ) n.properties = props;
+    contexts_[n.id] = n;
+    auto rec = node_json(n, "context");
+    rec->obj["op"] = Json::mkstr("context");
+    wal(rec);
+    return ok_id(n.id);
+  }
+
+  JsonPtr update_execution(const JsonPtr& req) {
+    int64_t id = (int64_t)req->num_at("id");
+    auto it = executions_.find(id);
+    if (it == executions_.end()) return error("no execution");
+    std::string state = req->str_at("state");
+    if (!state.empty()) it->second.state = state;
+    auto props = req->get("properties");
+    if (props && props->type == Json::OBJ)
+      for (auto& kv : props->obj) it->second.properties->obj[kv.first] =
+          kv.second;
+    auto rec = Json::mkobj();
+    rec->obj["op"] = Json::mkstr("update_execution");
+    rec->obj["id"] = Json::mknum((double)id);
+    rec->obj["state"] = Json::mkstr(state);
+    rec->obj["properties"] = props ? props : Json::mkobj();
+    wal(rec);
+    auto r = Json::mkobj();
+    r->obj["ok"] = Json::mkbool(true);
+    return r;
+  }
+
+  JsonPtr put_event(const JsonPtr& req) {
+    EventRec ev;
+    ev.execution = (int64_t)req->num_at("execution");
+    ev.artifact = (int64_t)req->num_at("artifact");
+    ev.type = req->str_at("type");
+    ev.path = req->str_at("path");
+    if (!executions_.count(ev.execution)) return error("no execution");
+    if (!artifacts_.count(ev.artifact)) return error("no artifact");
+    events_.push_back(ev);
+    auto rec = Json::mkobj();
+    rec->obj["op"] = Json::mkstr("event");
+    rec->obj["execution"] = Json::mknum((double)ev.execution);
+    rec->obj["artifact"] = Json::mknum((double)ev.artifact);
+    rec->obj["type"] = Json::mkstr(ev.type);
+    rec->obj["path"] = Json::mkstr(ev.path);
+    wal(rec);
+    auto r = Json::mkobj();
+    r->obj["ok"] = Json::mkbool(true);
+    return r;
+  }
+
+  JsonPtr put_link(std::vector<std::pair<int64_t, int64_t>>& links,
+                   const JsonPtr& req, const char* member, const char* op) {
+    int64_t ctx = (int64_t)req->num_at("context");
+    int64_t other = (int64_t)req->num_at(member);
+    links.emplace_back(ctx, other);
+    auto rec = Json::mkobj();
+    rec->obj["op"] = Json::mkstr(op);
+    rec->obj["context"] = Json::mknum((double)ctx);
+    rec->obj[member] = Json::mknum((double)other);
+    wal(rec);
+    auto r = Json::mkobj();
+    r->obj["ok"] = Json::mkbool(true);
+    return r;
+  }
+
+  JsonPtr get_node(std::map<int64_t, Node>& table, const JsonPtr& req) {
+    int64_t id = (int64_t)req->num_at("id");
+    auto it = table.find(id);
+    if (it == table.end()) return error("not found");
+    return node_json(it->second,
+                     &table == &artifacts_ ? "artifact" : "execution");
+  }
+
+  JsonPtr context_by_name(const JsonPtr& req) {
+    std::string type = req->str_at("type"), name = req->str_at("name");
+    for (auto& kv : contexts_)
+      if (kv.second.type == type && kv.second.name == name)
+        return node_json(kv.second, "context");
+    return error("not found");
+  }
+
+  JsonPtr in_context(const std::vector<std::pair<int64_t, int64_t>>& links,
+                     std::map<int64_t, Node>& table, const JsonPtr& req) {
+    int64_t ctx = (int64_t)req->num_at("context");
+    auto out = Json::mkarr();
+    const char* kind = &table == &artifacts_ ? "artifact" : "execution";
+    for (auto& link : links)
+      if (link.first == ctx && table.count(link.second))
+        out->arr.push_back(node_json(table[link.second], kind));
+    auto r = Json::mkobj();
+    r->obj["items"] = out;
+    return r;
+  }
+
+  JsonPtr producer(const JsonPtr& req) {
+    int64_t aid = (int64_t)req->num_at("artifact");
+    for (auto& ev : events_)
+      if (ev.artifact == aid && ev.type == "OUTPUT")
+        return node_json(executions_[ev.execution], "execution");
+    return error("not found");
+  }
+
+  JsonPtr io_of(const JsonPtr& req, const char* type) {
+    int64_t eid = (int64_t)req->num_at("execution");
+    auto out = Json::mkarr();
+    for (auto& ev : events_)
+      if (ev.execution == eid && ev.type == type)
+        out->arr.push_back(node_json(artifacts_[ev.artifact], "artifact"));
+    auto r = Json::mkobj();
+    r->obj["items"] = out;
+    return r;
+  }
+
+  JsonPtr lineage(const JsonPtr& req, bool up) {
+    int64_t start = (int64_t)req->num_at("artifact");
+    std::set<int64_t> seen;
+    std::vector<int64_t> frontier{start}, order;
+    while (!frontier.empty()) {
+      std::vector<int64_t> next;
+      for (int64_t aid : frontier) {
+        if (up) {
+          for (auto& ev : events_) {
+            if (ev.artifact != aid || ev.type != "OUTPUT") continue;
+            for (auto& in : events_) {
+              if (in.execution == ev.execution && in.type == "INPUT" &&
+                  !seen.count(in.artifact)) {
+                seen.insert(in.artifact);
+                order.push_back(in.artifact);
+                next.push_back(in.artifact);
+              }
+            }
+          }
+        } else {
+          for (auto& ev : events_) {
+            if (ev.artifact != aid || ev.type != "INPUT") continue;
+            for (auto& outev : events_) {
+              if (outev.execution == ev.execution &&
+                  outev.type == "OUTPUT" && !seen.count(outev.artifact)) {
+                seen.insert(outev.artifact);
+                order.push_back(outev.artifact);
+                next.push_back(outev.artifact);
+              }
+            }
+          }
+        }
+      }
+      frontier = next;
+    }
+    auto out = Json::mkarr();
+    for (int64_t aid : order)
+      out->arr.push_back(node_json(artifacts_[aid], "artifact"));
+    auto r = Json::mkobj();
+    r->obj["items"] = out;
+    return r;
+  }
+
+  void replay() {
+    std::ifstream f(wal_path_);
+    if (!f.good()) return;
+    std::string line;
+    std::string wal_save = wal_path_;
+    wal_path_.clear();               // suppress re-logging during replay
+    while (std::getline(f, line)) {
+      if (line.empty()) continue;
+      JsonPtr rec;
+      try {
+        rec = JsonParser(line).parse();
+      } catch (...) {
+        continue;                    // torn tail write; ignore
+      }
+      std::string op = rec->str_at("op");
+      auto load_node = [&](std::map<int64_t, Node>& table) {
+        Node n;
+        n.id = (int64_t)rec->num_at("id");
+        n.type = rec->str_at("type");
+        n.name = rec->str_at("name");
+        n.uri = rec->str_at("uri");
+        n.state = rec->str_at("state");
+        auto props = rec->get("properties");
+        if (props && props->type == Json::OBJ) n.properties = props;
+        table[n.id] = n;
+        if (n.id > ids_) ids_ = n.id;
+      };
+      if (op == "artifact") load_node(artifacts_);
+      else if (op == "execution") load_node(executions_);
+      else if (op == "context") load_node(contexts_);
+      else if (op == "update_execution") {
+        auto it = executions_.find((int64_t)rec->num_at("id"));
+        if (it != executions_.end()) {
+          std::string st = rec->str_at("state");
+          if (!st.empty()) it->second.state = st;
+          auto props = rec->get("properties");
+          if (props && props->type == Json::OBJ)
+            for (auto& kv : props->obj)
+              it->second.properties->obj[kv.first] = kv.second;
+        }
+      } else if (op == "event") {
+        EventRec ev;
+        ev.execution = (int64_t)rec->num_at("execution");
+        ev.artifact = (int64_t)rec->num_at("artifact");
+        ev.type = rec->str_at("type");
+        ev.path = rec->str_at("path");
+        events_.push_back(ev);
+      } else if (op == "assoc") {
+        associations_.emplace_back((int64_t)rec->num_at("context"),
+                                   (int64_t)rec->num_at("execution"));
+      } else if (op == "attr") {
+        attributions_.emplace_back((int64_t)rec->num_at("context"),
+                                   (int64_t)rec->num_at("artifact"));
+      }
+    }
+    wal_path_ = wal_save;
+  }
+};
+
+// --------------------------------------------------------------- server ----
+
+static bool read_exact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, buf + got, n - got);
+    if (r <= 0) return false;
+    got += (size_t)r;
+  }
+  return true;
+}
+
+static bool write_exact(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = write(fd, buf + sent, n - sent);
+    if (r <= 0) return false;
+    sent += (size_t)r;
+  }
+  return true;
+}
+
+static void serve_client(int fd, Store* store) {
+  while (true) {
+    char hdr[4];
+    if (!read_exact(fd, hdr, 4)) break;
+    uint32_t len = ntohl(*(uint32_t*)hdr);
+    if (len > (64u << 20)) break;    // 64MB sanity cap
+    std::string body(len, '\0');
+    if (!read_exact(fd, body.data(), len)) break;
+    std::string out;
+    try {
+      out = dumps(store->handle(JsonParser(body).parse()));
+    } catch (const std::exception& e) {
+      auto err = Json::mkobj();
+      err->obj["error"] = Json::mkstr(e.what());
+      out = dumps(err);
+    }
+    uint32_t olen = htonl((uint32_t)out.size());
+    if (!write_exact(fd, (char*)&olen, 4)) break;
+    if (!write_exact(fd, out.data(), out.size())) break;
+  }
+  close(fd);
+}
+
+int main(int argc, char** argv) {
+  int port = 0;
+  std::string wal;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--port" && i + 1 < argc) port = std::atoi(argv[++i]);
+    else if (a == "--wal" && i + 1 < argc) wal = argv[++i];
+  }
+  Store store(wal);
+
+  int sock = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(sock, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(sock, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    std::cerr << "bind failed\n";
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(sock, (sockaddr*)&addr, &alen);
+  listen(sock, 64);
+  std::cout << "LISTENING " << ntohs(addr.sin_port) << std::endl;
+
+  while (true) {
+    int fd = accept(sock, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_client, fd, &store).detach();
+  }
+}
