@@ -50,19 +50,34 @@ class LlamaConfig:
     attn_impl: str = "xla"            # "xla" | "flash" | "pallas"
     remat: str = "full"               # "none" | "full" | "dots"
     z_loss: float = 1e-4
+    # MoE (0 experts = dense MLP). Mixtral-style: the FFN becomes a routed
+    # mixture; attention/embeddings unchanged (SURVEY.md §2.7 'EP').
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
     def flops_per_token(self) -> float:
-        """Approx model FLOPs per token (fwd+bwd = 3x fwd matmul FLOPs)."""
+        """Approx model FLOPs per token (fwd+bwd = 3x fwd matmul FLOPs).
+        For MoE only the top-k experts' FFN FLOPs are active per token."""
         d, m, v = self.dim, self.mlp_dim, self.vocab_size
         attn_proj = 2 * d * (self.n_heads + 2 * self.n_kv_heads) * self.head_dim
         attn_out = 2 * self.n_heads * self.head_dim * d
-        mlp = 2 * 3 * d * m
+        active_ffns = self.moe_top_k if self.n_experts else 1
+        mlp = 2 * 3 * d * m * active_ffns
         per_layer = attn_proj + attn_out + mlp
         return 3 * (self.n_layers * per_layer + 2 * d * v)
+
+    def moe_config(self):
+        from kubeflow_tpu.parallel.moe import MoEConfig
+
+        return MoEConfig(
+            dim=self.dim, mlp_dim=self.mlp_dim, n_experts=self.n_experts,
+            top_k=self.moe_top_k, capacity_factor=self.moe_capacity_factor,
+            dtype=self.dtype)
 
 
 def llama3_8b(**kw) -> LlamaConfig:
@@ -91,6 +106,13 @@ def llama_tiny(**kw) -> LlamaConfig:
     )
 
 
+def llama_moe_8x(base: LlamaConfig | None = None, n_experts: int = 8,
+                 **kw) -> LlamaConfig:
+    """Mixtral-style MoE variant of any base config (default 8 experts)."""
+    base = base or llama3_8b()
+    return dataclasses.replace(base, n_experts=n_experts, **kw)
+
+
 # ---------------------------------------------------------------------------
 # Params
 # ---------------------------------------------------------------------------
@@ -107,20 +129,32 @@ def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32):
         return (jax.random.normal(key, shape, dtype=jnp.float32)
                 * (fan_in ** -0.5)).astype(dtype)
 
-    ks = jax.random.split(k_layers, 7)
-    params = {
-        "embed": dense(k_embed, (cfg.vocab_size, d), d),
-        "layers": {
-            "attn_norm": jnp.ones((L, d), dtype),
-            "mlp_norm": jnp.ones((L, d), dtype),
-            "wq": dense(ks[0], (L, d, h, hd), d),
-            "wk": dense(ks[1], (L, d, kv, hd), d),
-            "wv": dense(ks[2], (L, d, kv, hd), d),
-            "wo": dense(ks[3], (L, h, hd, d), h * hd),
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "mlp_norm": jnp.ones((L, d), dtype),
+        "wq": dense(ks[0], (L, d, h, hd), d),
+        "wk": dense(ks[1], (L, d, kv, hd), d),
+        "wv": dense(ks[2], (L, d, kv, hd), d),
+        "wo": dense(ks[3], (L, h, hd, d), h * hd),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        layers.update({
+            "moe_router": dense(ks[7], (L, d, E), d),
+            "w_gate": dense(ks[4], (L, E, d, m), d),
+            "w_up": dense(ks[5], (L, E, d, m), d),
+            "w_down": dense(ks[6], (L, E, m, d), m),
+        })
+    else:
+        layers.update({
             "w_gate": dense(ks[4], (L, d, m), d),
             "w_up": dense(ks[5], (L, d, m), d),
             "w_down": dense(ks[6], (L, m, d), m),
-        },
+        })
+    params = {
+        "embed": dense(k_embed, (cfg.vocab_size, d), d),
+        "layers": layers,
         "final_norm": jnp.ones((d,), dtype),
     }
     if not cfg.tie_embeddings:
@@ -130,19 +164,30 @@ def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32):
 
 def param_logical_axes(cfg: LlamaConfig):
     """Logical axis names per param, mirroring init_params' structure."""
-    axes = {
-        "embed": ("vocab", "embed"),
-        "layers": {
-            "attn_norm": ("layers", "embed"),
-            "mlp_norm": ("layers", "embed"),
-            "wq": ("layers", "embed", "heads", "head_dim"),
-            "wk": ("layers", "embed", "kv_heads", "head_dim"),
-            "wv": ("layers", "embed", "kv_heads", "head_dim"),
-            "wo": ("layers", "heads", "head_dim", "embed"),
+    layer_axes = {
+        "attn_norm": ("layers", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+    }
+    if cfg.n_experts:
+        layer_axes.update({
+            "moe_router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layer_axes.update({
             "w_gate": ("layers", "embed", "mlp"),
             "w_up": ("layers", "embed", "mlp"),
             "w_down": ("layers", "mlp", "embed"),
-        },
+        })
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layer_axes,
         "final_norm": ("embed",),
     }
     if not cfg.tie_embeddings:
@@ -154,8 +199,26 @@ def param_logical_axes(cfg: LlamaConfig):
 # Forward
 # ---------------------------------------------------------------------------
 
+def _ffn(h, lp, cfg: LlamaConfig):
+    """FFN half of a block on the normed input h: (delta, aux_loss_scalar).
+    Dense SwiGLU, or the routed MoE mixture when cfg.n_experts > 0."""
+    if cfg.n_experts:
+        from kubeflow_tpu.parallel.moe import moe_aux_total, moe_layer
+
+        moe_params = {"router": lp["moe_router"], "w_gate": lp["w_gate"],
+                      "w_up": lp["w_up"], "w_down": lp["w_down"]}
+        y, aux = moe_layer(moe_params, h, cfg.moe_config())
+        return y, moe_aux_total(aux)
+    gate = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
+    ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "act_mlp"))
+    down = jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
+    return down, jnp.zeros((), jnp.float32)
+
+
 def _block(x, lp, inv_freq, positions, cfg: LlamaConfig, mesh=None):
-    """One transformer block. x: [B,S,D] in compute dtype."""
+    """One transformer block. x: [B,S,D] in compute dtype.
+    Returns (x, aux_loss_scalar)."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
@@ -179,11 +242,8 @@ def _block(x, lp, inv_freq, positions, cfg: LlamaConfig, mesh=None):
     x = x + constrain(o, ("batch", "seq", "act_embed"))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
-    up = jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
-    ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "act_mlp"))
-    down = jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
-    return x + constrain(down, ("batch", "seq", "act_embed"))
+    down, aux = _ffn(h, lp, cfg)
+    return x + constrain(down, ("batch", "seq", "act_embed")), aux
 
 
 def _remat_wrap(fn, cfg: LlamaConfig):
@@ -195,11 +255,14 @@ def _remat_wrap(fn, cfg: LlamaConfig):
     return jax.checkpoint(fn)
 
 
-def forward(params, tokens, cfg: LlamaConfig, positions=None, mesh=None):
+def forward(params, tokens, cfg: LlamaConfig, positions=None, mesh=None,
+            return_aux: bool = False):
     """Full-sequence forward. tokens: [B,S] int32 -> logits [B,S,V] (f32).
 
     `mesh` is only needed for the context-parallel attention impls
     ("ring"/"ulysses"), which run shard_map collectives over it.
+    With ``return_aux`` returns (logits, aux) where aux carries the summed
+    MoE penalties (zero for dense configs) — add it to the training loss.
     """
     if positions is None:
         positions = jnp.arange(tokens.shape[1])[None, :]
@@ -211,15 +274,18 @@ def forward(params, tokens, cfg: LlamaConfig, positions=None, mesh=None):
     x = constrain(x, ("batch", "seq", "act_embed"))
 
     block = _remat_wrap(
-        lambda x, lp: (_block(x, lp, inv_freq, positions, cfg, mesh), None), cfg
+        lambda x, lp: _block(x, lp, inv_freq, positions, cfg, mesh), cfg
     )
-    x, _ = jax.lax.scan(block, x, params["layers"])
+    x, aux_per_layer = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
     logits = constrain(logits, ("batch", "seq", None))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, {"moe_aux": jnp.sum(aux_per_layer)}
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -268,10 +334,8 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
         o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        ff = jax.nn.silu(
-            jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
-        ) * jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
-        x = x + jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
+        down, _ = _ffn(h, lp, cfg)
+        x = x + down
         new_k = jax.lax.dynamic_update_slice(
             k_cache_l, k.astype(k_cache_l.dtype), (0, 0, 0, 0)
         )
@@ -321,10 +385,8 @@ def decode_step(params, token, cfg: LlamaConfig, cache):
         o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        ff = jax.nn.silu(
-            jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
-        ) * jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
-        x = x + jnp.einsum("bsm,md->bsd", ff, lp["w_down"].astype(cfg.dtype))
+        down, _ = _ffn(h, lp, cfg)
+        x = x + down
         return x, (new_k, new_v)
 
     x, (new_k, new_v) = jax.lax.scan(

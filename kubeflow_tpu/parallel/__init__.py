@@ -13,3 +13,15 @@ from kubeflow_tpu.parallel.sharding import (
     tree_pspecs,
     tree_shardings,
 )
+from kubeflow_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_aux_total,
+    moe_layer,
+    moe_param_logical_axes,
+)
+from kubeflow_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_loss_fn,
+    stack_stage_params,
+)

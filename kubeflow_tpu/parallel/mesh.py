@@ -8,13 +8,13 @@ for tensor/context parallelism onto the fastest interconnect dimension.
 
 Canonical axis names (outer -> inner):
 
+- ``pipeline`` — pipeline stages (small p2p transfers; the most
+  DCN-tolerant axis, so outermost).
 - ``data``     — pure data parallelism (gradient all-reduce; DCN-tolerant).
 - ``fsdp``     — data parallelism with parameter/optimizer sharding (ZeRO-3).
 - ``expert``   — MoE expert parallelism (all-to-all dispatch).
 - ``context``  — sequence/context parallelism (ring attention KV rotation).
 - ``tensor``   — tensor (Megatron-style) parallelism; innermost = fastest ICI.
-
-``pipeline`` is handled separately by ``parallel.pipeline`` (stage meshes).
 """
 
 from __future__ import annotations
@@ -29,7 +29,7 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 # Outer-to-inner canonical order; DCN-friendly axes first, ICI-hungry last.
-AXIS_ORDER = ("data", "fsdp", "expert", "context", "tensor")
+AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "context", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +41,7 @@ class MeshConfig:
     slices devoted to data/fsdp replication across DCN); 1 = single slice.
     """
 
+    pipeline: int = 1
     data: int = 1
     fsdp: int = -1
     expert: int = 1
@@ -48,9 +49,11 @@ class MeshConfig:
     tensor: int = 1
     dcn_data: int = 1
     dcn_fsdp: int = 1
+    dcn_pipeline: int = 1
 
     def ici_sizes(self) -> dict[str, int]:
         return {
+            "pipeline": self.pipeline,
             "data": self.data,
             "fsdp": self.fsdp,
             "expert": self.expert,
@@ -60,7 +63,7 @@ class MeshConfig:
 
     def resolved(self, n_devices: int) -> "MeshConfig":
         """Resolve any -1 axis against the device count (per slice)."""
-        n_slices = self.dcn_data * self.dcn_fsdp
+        n_slices = self.dcn_data * self.dcn_fsdp * self.dcn_pipeline
         if n_devices % n_slices != 0:
             raise ValueError(
                 f"{n_devices} devices not divisible by {n_slices} slices"
@@ -102,14 +105,25 @@ def build_mesh(
     cfg = config.resolved(len(devices))
     ici = [cfg.ici_sizes()[a] for a in AXIS_ORDER]
 
-    if cfg.dcn_data == 1 and cfg.dcn_fsdp == 1:
+    if cfg.dcn_data == 1 and cfg.dcn_fsdp == 1 and cfg.dcn_pipeline == 1:
         dev_array = mesh_utils.create_device_mesh(ici, devices=devices)
         return Mesh(dev_array, AXIS_ORDER)
 
-    dcn = [cfg.dcn_data, cfg.dcn_fsdp, 1, 1, 1]
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        ici, dcn_mesh_shape=dcn, devices=devices
-    )
+    dcn = [cfg.dcn_pipeline, cfg.dcn_data, cfg.dcn_fsdp, 1, 1, 1]
+    if hasattr(devices[0], "slice_index"):
+        # real multi-slice TPU topology: genuine config errors must surface
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn_mesh_shape=dcn, devices=devices
+        )
+    else:
+        # CPU/virtual devices carry no slice_index attribute (the CI
+        # emulation path, SURVEY.md §4): emulate slices as contiguous
+        # device blocks and merge each dcn axis with its ici axis.
+        arr = np.array(devices).reshape(*dcn, *ici)
+        n = len(AXIS_ORDER)
+        perm = [axis for i in range(n) for axis in (i, n + i)]
+        arr = arr.transpose(perm)
+        dev_array = arr.reshape([d * i for d, i in zip(dcn, ici)])
     # hybrid mesh returns shape [dcn_data*data', dcn_fsdp*fsdp', ...]; axes are
     # already merged per dimension by create_hybrid_device_mesh.
     return Mesh(dev_array, AXIS_ORDER)

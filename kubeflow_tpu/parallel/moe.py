@@ -1,0 +1,133 @@
+"""Mixture-of-Experts with expert parallelism (SURVEY.md §2.7 'EP').
+
+TPU-first design: Switch/GShard-style *dense dispatch* — tokens are routed
+into a per-expert capacity buffer with einsum one-hots, the expert FFN runs
+batched over the expert dim, and sharding constraints put the expert dim on
+the ``expert`` mesh axis so XLA emits the all-to-all. Static shapes
+throughout (capacity buffers, no ragged ops), which is exactly what the MXU
+and the XLA scheduler want; overflow tokens are dropped by capacity like the
+reference implementations.
+
+Aux objectives: Switch load-balancing loss + router z-loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    dim: int
+    mlp_dim: int
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_z_coef: float = 1e-3
+    load_balance_coef: float = 1e-2
+    dtype: jnp.dtype = jnp.float32
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig):
+    kr, kg, ku, kd = jax.random.split(rng, 4)
+    d, m, e = cfg.dim, cfg.mlp_dim, cfg.n_experts
+    scale = 1.0 / math.sqrt(d)
+    return {
+        "router": jax.random.normal(kr, (d, e)) * scale,
+        "w_gate": jax.random.normal(kg, (e, d, m)) * scale,
+        "w_up": jax.random.normal(ku, (e, d, m)) * scale,
+        "w_down": jax.random.normal(kd, (e, m, d)) * (1.0 / math.sqrt(m)),
+    }
+
+
+def moe_param_logical_axes(cfg: MoEConfig):
+    return {
+        "router": ("embed", None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_layer(params, x, cfg: MoEConfig, *, capacity: Optional[int] = None):
+    """Apply the MoE FFN. x: [B, S, D] -> (y [B, S, D], aux_losses dict).
+
+    Dense dispatch: combine/dispatch tensors [G, E, C] (G = B*S tokens)
+    contract tokens into per-expert capacity buffers and back. Sharding
+    constraints place E on the `expert` mesh axis (all-to-all emitted by
+    XLA) and tokens on the data axes.
+    """
+    b, s, d = x.shape
+    g = b * s
+    e, k = cfg.n_experts, cfg.top_k
+    if capacity is None:
+        capacity = max(1, int(math.ceil(g * k / e * cfg.capacity_factor)))
+
+    tokens = x.reshape(g, d)
+    logits = tokens.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                    # [G, E]
+
+    # top-k expert choice per token
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)             # [G, k]
+    # renormalize the chosen experts' weights
+    topk_probs = topk_probs / jnp.maximum(
+        topk_probs.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) in its expert's capacity buffer:
+    # cumulative count of prior assignments to the same expert. Flatten
+    # choices in priority order (choice 0 of every token first).
+    flat_idx = topk_idx.T.reshape(-1)                          # [k*G]
+    onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)      # [k*G, E]
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [k*G, E]
+    pos = pos_in_expert.sum(-1)                                # [k*G]
+    keep = pos < capacity
+    pos = jnp.where(keep, pos, 0)
+
+    # dispatch/combine tensors
+    disp = (jax.nn.one_hot(flat_idx, e, dtype=cfg.dtype)[:, :, None]
+            * jax.nn.one_hot(pos, capacity, dtype=cfg.dtype)[:, None, :]
+            * keep[:, None, None])                             # [k*G, E, C]
+    disp = disp.reshape(k, g, e, capacity)
+    weights = topk_probs.T.reshape(k, g).astype(cfg.dtype)     # [k, G]
+    combine = (disp * weights[:, :, None, None]).sum(0)        # [G, E, C]
+    dispatch = disp.sum(0)                                     # [G, E, C]
+
+    # expert-parallel compute: [E, C, D] buffers, E on the expert mesh axis
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch,
+                           tokens.astype(cfg.dtype))
+    expert_in = constrain(expert_in, ("expert", None, "act_embed"))
+    h = jax.nn.silu(jnp.einsum("ecd,edm->ecm", expert_in,
+                               params["w_gate"].astype(cfg.dtype)))
+    h = h * jnp.einsum("ecd,edm->ecm", expert_in,
+                       params["w_up"].astype(cfg.dtype))
+    expert_out = jnp.einsum("ecm,emd->ecd", h,
+                            params["w_down"].astype(cfg.dtype))
+    expert_out = constrain(expert_out, ("expert", None, "act_embed"))
+
+    y = jnp.einsum("gec,ecd->gd", combine, expert_out)
+
+    # aux losses (float32 for stability)
+    # load balance: E * sum_e fraction_tokens_e * mean_router_prob_e
+    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    frac_tokens = top1.mean(0)
+    frac_probs = probs.mean(0)
+    lb_loss = e * jnp.sum(frac_tokens * frac_probs)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    aux = {
+        "moe_load_balance": cfg.load_balance_coef * lb_loss,
+        "moe_router_z": cfg.router_z_coef * z_loss,
+        "moe_dropped_fraction": (~keep).astype(jnp.float32).mean(),
+    }
+    return y.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_aux_total(aux: dict) -> jax.Array:
+    """Sum of the differentiable aux penalties (exclude diagnostics)."""
+    return aux["moe_load_balance"] + aux["moe_router_z"]

@@ -1,0 +1,128 @@
+"""Pipeline parallelism — GPipe-style microbatch pipeline over a mesh axis.
+
+The reference delegates PP to user containers (Megatron/DeepSpeed stages
+across pods, SURVEY.md §2.7 'PP'). The TPU-native design keeps every stage
+in ONE jitted SPMD program: stage parameters are sharded over the
+``pipeline`` mesh axis (stacked on a leading stage dim), and microbatch
+activations stream between stages with ``jax.lax.ppermute`` inside
+``shard_map`` — XLA overlaps the permute (small p2p transfer, DCN-tolerant)
+with the next microbatch's compute. No MPMD launcher, no per-stage process
+groups.
+
+Schedule: GPipe fill-drain. For S stages and M microbatches each device
+ticks S+M-1 times; stage s is idle for s ticks at fill and S-1-s at drain
+(the usual bubble; 1F1B would need per-stage weight gradients resident,
+same comms pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map                       # jax >= 0.8
+except ImportError:                                 # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def stack_stage_params(per_stage_params: list) -> jax.Array:
+    """Stack per-stage parameter pytrees on a leading 'stage' dim: the result
+    is sharded over the pipeline axis so each device holds its stage only."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis: str = "pipeline",
+    microbatches: int,
+    batch_spec: P = P(),
+) -> Callable:
+    """Build ``fn(stacked_params, x) -> y`` running stage_fn as a pipeline.
+
+    - ``stage_fn(stage_params, x) -> y``: one stage's computation; x/y have
+      identical shapes (the inter-stage activation contract).
+    - ``stacked_params``: pytree with leading stage dim (see
+      stack_stage_params), sharded P(axis) on dim 0.
+    - ``x``: [batch, ...] global batch; split into ``microbatches`` equal
+      microbatches along dim 0.
+
+    Returns the pipelined function (jit-able; grads flow through ppermute).
+    """
+    n_stages = mesh.shape[axis]
+
+    def impl(stacked_params, x):
+        # inside shard_map: stacked_params has stage dim 1 (this device's
+        # stage); x is the full per-shard batch
+        local_params = jax.tree_util.tree_map(
+            lambda p: p[0], stacked_params)
+        stage = jax.lax.axis_index(axis)
+        mb = jnp.reshape(
+            x, (microbatches, x.shape[0] // microbatches, *x.shape[1:]))
+        mb_shape = mb.shape[1:]
+
+        total = microbatches + n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            buf, out = carry
+            # stage 0 ingests microbatch t (zeros once input is exhausted)
+            inject = mb[jnp.minimum(t, microbatches - 1)]
+            inject = jnp.where(t < microbatches, inject,
+                               jnp.zeros_like(inject))
+            state_in = jnp.where(stage == 0, inject, buf)
+            y = stage_fn(local_params, state_in)
+            # the LAST stage's output for microbatch t-(S-1) is ready now
+            out_idx = t - (n_stages - 1)
+            out = jnp.where(
+                (stage == n_stages - 1) & (out_idx >= 0),
+                out.at[jnp.maximum(out_idx, 0)].set(y),
+                out)
+            # stream activations to the next stage (ring; last->0 ignored)
+            buf = jax.lax.ppermute(y, axis, fwd_perm)
+            return (buf, out), None
+
+        buf0 = jnp.zeros(mb_shape, x.dtype)
+        out0 = jnp.zeros((microbatches, *mb_shape), x.dtype)
+        (_, out), _ = jax.lax.scan(
+            tick, (buf0, out0), jnp.arange(total))
+        # collected on the last stage; psum-broadcast so the result is
+        # replicated over the pipeline axis (loss computed everywhere)
+        out = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)),
+            axis)
+        return jnp.reshape(out, (x.shape[0], *mb_shape[1:]))
+
+    # params: stage dim over the pipeline axis (a prefix spec covers every
+    # leaf); activations replicated over it, sharded per batch_spec elsewhere
+    kwargs = dict(mesh=mesh, in_specs=(P(axis), batch_spec),
+                  out_specs=batch_spec)
+    try:
+        return shard_map(impl, check_vma=False, **kwargs)   # jax >= 0.8
+    except TypeError:
+        return shard_map(impl, check_rep=False, **kwargs)
+
+
+def pipeline_loss_fn(
+    stage_fn: Callable,
+    loss_head: Callable,
+    mesh: Mesh,
+    *,
+    axis: str = "pipeline",
+    microbatches: int,
+):
+    """Compose pipeline_apply with a loss head: returns
+    ``loss(stacked_params, head_params, x, targets) -> scalar``."""
+    fwd = pipeline_apply(stage_fn, mesh, axis=axis, microbatches=microbatches)
+
+    def loss(stacked_params, head_params, x, targets):
+        y = fwd(stacked_params, x)
+        return loss_head(head_params, y, targets)
+
+    return loss

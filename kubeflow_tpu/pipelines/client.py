@@ -27,6 +27,7 @@ class RecurringRun:
     last_fire: float = 0.0
     max_concurrency: int = 1
     run_ids: list[str] = dataclasses.field(default_factory=list)
+    last_error: str = ""
     _inflight: int = 0
 
 
@@ -113,14 +114,18 @@ class PipelineClient:
                     rr._inflight += 1
                     due.append(rr)
         for rr in due:
+            # one failing schedule must not starve the others this tick
             try:
                 result = self.create_run(
                     rr.pipeline, arguments=rr.arguments,
                     run_id=f"{rr.pipeline}-{rr.name}-{int(now)}")
-            finally:
+            except Exception as e:
                 with self._lock:
                     rr._inflight -= 1
+                    rr.last_error = f"{type(e).__name__}: {e}"
+                continue
             with self._lock:
+                rr._inflight -= 1
                 rr.run_ids.append(result.run_id)
             fired.append(result)
         return fired

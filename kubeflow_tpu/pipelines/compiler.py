@@ -67,13 +67,14 @@ def compile_pipeline(pipe: dsl.Pipeline) -> dict:
         deps = sorted(set(task.dependencies))
         if deps:
             t["dependentTasks"] = deps
-        if task.condition is not None:
-            t["triggerCondition"] = _encode_condition(task.condition)
-        if task.loop is not None:
-            t["iterator"] = {
-                "loopId": task.loop.loop_id,
-                "items": _encode_value(task.loop.items),
-            }
+        if task.conditions:
+            t["triggerConditions"] = [
+                _encode_condition(c) for c in task.conditions]
+        if task.loops:
+            t["iterators"] = [
+                {"loopId": lp.loop_id, "items": _encode_value(lp.items)}
+                for lp in task.loops
+            ]
         if task.is_exit_handler:
             t["exitHandler"] = True
         tasks[task.name] = t
@@ -84,7 +85,7 @@ def compile_pipeline(pipe: dsl.Pipeline) -> dict:
         "root": {
             "inputDefinitions": {
                 "parameters": {
-                    k: ({"defaultValue": v} if v is not None else {})
+                    k: ({} if v is dsl.REQUIRED else {"defaultValue": v})
                     for k, v in pipe.spec.params.items()
                 }
             },

@@ -231,8 +231,10 @@ class Task:
     component: Component
     arguments: dict[str, Any]
     dependencies: list[str] = dataclasses.field(default_factory=list)
-    condition: Optional[ConditionExpr] = None
-    loop: Optional["ParallelFor"] = None      # enclosing loop, if any
+    # ALL enclosing conditions (outermost first) — every one must hold
+    conditions: list[ConditionExpr] = dataclasses.field(default_factory=list)
+    # ALL enclosing loops (outermost first) — expansion is their product
+    loops: list["ParallelFor"] = dataclasses.field(default_factory=list)
     is_exit_handler: bool = False
 
     @property
@@ -284,11 +286,9 @@ class _PipelineContext:
         n = self._names.get(comp.name, 0)
         self._names[comp.name] = n + 1
         tname = comp.name if n == 0 else f"{comp.name}-{n + 1}"
-        task = Task(name=tname, component=comp, arguments=dict(args))
-        if self._cond_stack:
-            task.condition = self._cond_stack[-1]
-        if self._loop_stack:
-            task.loop = self._loop_stack[-1]
+        task = Task(name=tname, component=comp, arguments=dict(args),
+                    conditions=list(self._cond_stack),
+                    loops=list(self._loop_stack))
         self.tasks[tname] = task
         return task
 
@@ -354,11 +354,22 @@ class ExitHandler:
 
 # ------------------------------------------------------------- pipeline ----
 
+class _Required:
+    """Sentinel: pipeline parameter with no default (None IS a valid
+    default)."""
+
+    def __repr__(self):
+        return "<required>"
+
+
+REQUIRED = _Required()
+
+
 @dataclasses.dataclass
 class PipelineSpec:
     name: str
     fn: Callable
-    params: dict[str, Any]            # name -> default
+    params: dict[str, Any]            # name -> default | REQUIRED
 
 
 class Pipeline:
@@ -383,7 +394,7 @@ def pipeline(fn: Optional[Callable] = None, *, name: Optional[str] = None):
         sig = inspect.signature(f)
         params = {}
         for pname, p in sig.parameters.items():
-            params[pname] = (None if p.default is inspect.Parameter.empty
+            params[pname] = (REQUIRED if p.default is inspect.Parameter.empty
                              else p.default)
         return Pipeline(PipelineSpec(name=name or f.__name__, fn=f,
                                      params=params))

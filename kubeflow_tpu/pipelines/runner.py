@@ -5,6 +5,13 @@ resolution + cache check), the launcher (artifact IO + MLMD recording), and
 the cache server (SURVEY.md §2.5, §3.4). Here those roles are one runner
 with the same behaviors, executing over threads locally; the metadata
 backend is pluggable (in-proc store or the native C++ server).
+
+Loop expansion: tasks may sit under arbitrarily nested ParallelFor blocks;
+instances are the cross product of all enclosing loops, named
+``task[i][j]...``. References bind per-iteration: a consumer inside the same
+loops reads the same iteration's producer; a consumer OUTSIDE a producer's
+loops would need a collect/aggregate step, which is rejected with a clear
+error at expansion time.
 """
 
 from __future__ import annotations
@@ -14,6 +21,7 @@ import dataclasses
 import enum
 import hashlib
 import inspect
+import itertools
 import json
 import os
 import shutil
@@ -33,6 +41,9 @@ class TaskState(str, enum.Enum):
     FAILED = "Failed"
     SKIPPED = "Skipped"
     CACHED = "Cached"
+
+FINISHED = (TaskState.SUCCEEDED, TaskState.CACHED, TaskState.SKIPPED,
+            TaskState.FAILED)
 
 
 @dataclasses.dataclass
@@ -65,6 +76,18 @@ class _Skip(Exception):
     pass
 
 
+@dataclasses.dataclass
+class _Instance:
+    """One expanded task instance (a concrete loop iteration)."""
+
+    name: str
+    task: dsl.Task
+    loop_items: dict[str, Any]          # loop_id -> item value
+    idx: dict[str, int]                 # loop_id -> iteration index
+    base_loops: dict[str, list[str]]    # task base name -> its loop ids
+    deps: set[str] = dataclasses.field(default_factory=set)
+
+
 class LocalRunner:
     """Executes a traced pipeline graph. ``workdir`` holds artifacts and the
     execution cache; ``metadata`` records lineage."""
@@ -82,7 +105,8 @@ class LocalRunner:
     def run(self, pipe: dsl.Pipeline,
             arguments: Optional[dict[str, Any]] = None,
             run_id: Optional[str] = None) -> RunResult:
-        args = {k: v for k, v in pipe.spec.params.items() if v is not None}
+        args = {k: v for k, v in pipe.spec.params.items()
+                if v is not dsl.REQUIRED}
         args.update(arguments or {})
         missing = [k for k in pipe.spec.params if k not in args]
         if missing:
@@ -95,109 +119,111 @@ class LocalRunner:
         context_id = self.metadata.put_context(
             "pipeline_run", run_id, properties={"pipeline": pipe.name})
 
-        # expand ParallelFor groups into per-item task instances
-        tasks, loop_of = self._expand(ctx, args)
-
-        results = {name: TaskResult(name=name) for name in tasks}
-        lock = threading.Lock()
+        instances = self._expand(ctx, args)
+        results = {name: TaskResult(name=name) for name in instances}
         run_failed = threading.Event()
 
-        main = {n: t for n, t in tasks.items() if not t.is_exit_handler}
-        handlers = {n: t for n, t in tasks.items() if t.is_exit_handler}
+        main = {n: i for n, i in instances.items()
+                if not i.task.is_exit_handler}
+        handlers = {n: i for n, i in instances.items()
+                    if i.task.is_exit_handler}
 
-        self._execute_dag(main, results, args, ctx, run_dir, context_id,
-                          lock, run_failed, loop_of)
+        self._execute_dag(main, results, args, run_dir, context_id,
+                          run_failed)
         # exit handlers always run, even after failure
-        self._execute_dag(handlers, results, args, ctx, run_dir, context_id,
-                          lock, threading.Event(), loop_of)
+        self._execute_dag(handlers, results, args, run_dir, context_id,
+                          threading.Event())
 
-        state = TaskState.FAILED if run_failed.is_set() else TaskState.SUCCEEDED
+        state = (TaskState.FAILED if run_failed.is_set()
+                 else TaskState.SUCCEEDED)
         return RunResult(run_id=run_id, state=state, tasks=results,
                          params=args, context_id=context_id)
 
     # ------------------------------------------------- loop expansion ----
 
-    def _expand(self, ctx: dsl._PipelineContext, args: dict
-                ) -> tuple[dict[str, dsl.Task], dict[str, tuple[str, Any]]]:
-        """Fan ParallelFor bodies out per item. Returns (tasks, loop_of)
-        where loop_of maps expanded task name -> (loop_id, item)."""
-        tasks: dict[str, dsl.Task] = {}
-        loop_of: dict[str, tuple[str, Any]] = {}
-        loops: dict[str, list[dsl.Task]] = {}
+    def _expand(self, ctx: dsl._PipelineContext,
+                args: dict) -> dict[str, _Instance]:
+        base_loops: dict[str, list[str]] = {
+            t.name: [lp.loop_id for lp in t.loops]
+            for t in ctx.tasks.values()
+        }
+        # resolve every loop's item list once
+        items_of: dict[str, list] = {}
         for t in ctx.tasks.values():
-            if t.loop is None:
-                tasks[t.name] = t
-            else:
-                loops.setdefault(t.loop.loop_id, []).append(t)
-
-        # a task OUTSIDE a loop referencing a loop member has no single
-        # instance to bind to — needs a dynamic collect step (not yet built);
-        # fail at expansion with a clear message instead of a runtime race
-        loop_member_names = {m.name for ms in loops.values() for m in ms}
-        for t in tasks.values():
-            refs = [v for v in t.arguments.values()
-                    if isinstance(v, dsl.OutputRef)]
-            if t.condition is not None:
-                refs += [s for s in (t.condition.lhs, t.condition.rhs)
-                         if isinstance(s, dsl.OutputRef)]
-            for r in refs:
-                if r.task in loop_member_names:
+            for lp in t.loops:
+                if lp.loop_id in items_of:
+                    continue
+                items = lp.items
+                if isinstance(items, dsl.ParamRef):
+                    items = args[items.name]
+                elif isinstance(items, (dsl.OutputRef, dsl.LoopItemRef)):
                     raise NotImplementedError(
-                        f"task {t.name!r} consumes output of ParallelFor "
-                        f"member {r.task!r}; aggregating over a fan-out "
-                        f"requires a collect step, which is not supported "
-                        f"yet")
+                        "ParallelFor over a task output requires the dynamic "
+                        "driver; use a pipeline parameter or static list")
+                items_of[lp.loop_id] = list(items)
 
-        for loop_id, members in loops.items():
-            loop = members[0].loop
-            items = loop.items
-            if isinstance(items, dsl.ParamRef):
-                items = args[items.name]
-            elif isinstance(items, dsl.OutputRef):
-                raise NotImplementedError(
-                    "ParallelFor over a task output requires the dynamic "
-                    "driver; use a pipeline parameter or static list")
-            member_names = {m.name for m in members}
-            for i, item in enumerate(items):
-                for m in members:
-                    inst_name = f"{m.name}[{i}]"
-                    inst = dsl.Task(
-                        name=inst_name, component=m.component,
-                        arguments=dict(m.arguments),
-                        dependencies=[
-                            # intra-loop deps bind within the iteration
-                            (f"{d}[{i}]" if d in member_names else d)
-                            for d in m.dependencies
-                        ],
-                        condition=m.condition, loop=m.loop,
-                        is_exit_handler=m.is_exit_handler)
-                    tasks[inst_name] = inst
-                    loop_of[inst_name] = (loop_id, item)
-        return tasks, loop_of
+        instances: dict[str, _Instance] = {}
+        for t in ctx.tasks.values():
+            lids = base_loops[t.name]
+            ranges = [range(len(items_of[lid])) for lid in lids]
+            for combo in itertools.product(*ranges):
+                idx = dict(zip(lids, combo))
+                name = t.name + "".join(f"[{i}]" for i in combo)
+                instances[name] = _Instance(
+                    name=name, task=t,
+                    loop_items={lid: items_of[lid][i]
+                                for lid, i in idx.items()},
+                    idx=idx, base_loops=base_loops)
+
+        for inst in instances.values():
+            t = inst.task
+            targets = set(t.dependencies)
+            for v in t.arguments.values():
+                if isinstance(v, dsl.OutputRef):
+                    targets.add(v.task)
+            for c in t.conditions:
+                for side in (c.lhs, c.rhs):
+                    if isinstance(side, dsl.OutputRef):
+                        targets.add(side.task)
+            for ref in targets:
+                if ref in base_loops:
+                    inst.deps.add(self._bind(ref, inst, base_loops))
+        return instances
+
+    @staticmethod
+    def _bind(ref_base: str, inst: _Instance,
+              base_loops: dict[str, list[str]]) -> str:
+        """Expanded name of the referenced task's instance as seen from
+        ``inst``: every loop of the target must be one of ours."""
+        ref_lids = base_loops[ref_base]
+        missing = [lid for lid in ref_lids if lid not in inst.idx]
+        if missing:
+            raise NotImplementedError(
+                f"task {inst.task.name!r} consumes output of ParallelFor "
+                f"member {ref_base!r}; aggregating over a fan-out requires "
+                f"a collect step, which is not supported yet")
+        return ref_base + "".join(f"[{inst.idx[lid]}]" for lid in ref_lids)
 
     # ------------------------------------------------------ dag walk ----
 
-    def _execute_dag(self, tasks, results, args, ctx, run_dir, context_id,
-                     lock, run_failed, loop_of):
-        if not tasks:
+    def _execute_dag(self, instances, results, args, run_dir, context_id,
+                     run_failed):
+        if not instances:
             return
-        remaining = dict(tasks)
+        remaining = dict(instances)
         with concurrent.futures.ThreadPoolExecutor(self.max_workers) as pool:
             futures: dict[concurrent.futures.Future, str] = {}
             while remaining or futures:
                 ready = [
-                    n for n, t in remaining.items()
-                    if all(results[d].state in (TaskState.SUCCEEDED,
-                                                TaskState.CACHED,
-                                                TaskState.SKIPPED,
-                                                TaskState.FAILED)
-                           for d in self._deps(t, tasks, ctx, loop_of))
+                    n for n, inst in remaining.items()
+                    if all(results[d].state in FINISHED
+                           for d in inst.deps if d in results)
                 ]
                 for n in ready:
-                    t = remaining.pop(n)
+                    inst = remaining.pop(n)
                     futures[pool.submit(
-                        self._run_task, t, results, args, ctx, run_dir,
-                        context_id, lock, run_failed, loop_of)] = n
+                        self._run_task, inst, results, args, run_dir,
+                        context_id, run_failed)] = n
                 if not futures:
                     if remaining:    # dependency cycle or unresolvable
                         for n in remaining:
@@ -211,40 +237,14 @@ class LocalRunner:
                     futures.pop(f)
                     f.result()       # propagate runner bugs loudly
 
-    def _deps(self, task: dsl.Task, tasks, ctx, loop_of) -> set[str]:
-        """Explicit deps + data deps from argument references."""
-        deps = set(task.dependencies)
-        loop_item = loop_of.get(task.name)
-        for v in task.arguments.values():
-            if isinstance(v, dsl.OutputRef):
-                deps.add(self._ref_instance(v.task, task, tasks, loop_item))
-        expr = task.condition
-        if expr is not None:
-            for side in (expr.lhs, expr.rhs):
-                if isinstance(side, dsl.OutputRef):
-                    deps.add(self._ref_instance(side.task, task, tasks,
-                                                loop_item))
-        return {d for d in deps if d in tasks}
-
-    @staticmethod
-    def _ref_instance(ref_task: str, task: dsl.Task, tasks,
-                      loop_item) -> str:
-        """Inside loop iteration i, references to loop members bind to the
-        same iteration's instance."""
-        if loop_item is not None and task.name.endswith("]"):
-            idx = task.name[task.name.rfind("["):]
-            if f"{ref_task}{idx}" in tasks:
-                return f"{ref_task}{idx}"
-        return ref_task
-
     # ----------------------------------------------------- task exec ----
 
-    def _run_task(self, task, results, args, ctx, run_dir, context_id,
-                  lock, run_failed, loop_of):
-        result = results[task.name]
+    def _run_task(self, inst, results, args, run_dir, context_id,
+                  run_failed):
+        result = results[inst.name]
         try:
-            self._run_task_inner(task, results, args, run_dir, context_id,
-                                 lock, run_failed, loop_of, result)
+            self._run_task_inner(inst, results, args, run_dir, context_id,
+                                 run_failed, result)
         except _Skip as s:
             result.state = TaskState.SKIPPED
             result.error = str(s)
@@ -253,21 +253,22 @@ class LocalRunner:
             result.error = f"{type(e).__name__}: {e}"
             run_failed.set()
 
-    def _run_task_inner(self, task, results, args, run_dir, context_id,
-                        lock, run_failed, loop_of, result):
+    def _run_task_inner(self, inst, results, args, run_dir, context_id,
+                        run_failed, result):
+        task = inst.task
         spec = task.component.spec
-        loop_item = loop_of.get(task.name)
 
         # upstream failure/skip propagation
-        for d in self._deps(task, results, None, loop_of):
-            if results[d].state in (TaskState.FAILED, TaskState.SKIPPED):
+        for d in inst.deps:
+            if d in results and results[d].state in (TaskState.FAILED,
+                                                     TaskState.SKIPPED):
                 raise _Skip(f"upstream {d} {results[d].state.value.lower()}")
         if run_failed.is_set() and not task.is_exit_handler:
             raise _Skip("run already failed")
 
-        resolve = lambda v: self._resolve(v, results, args, task, loop_of)
-        if task.condition is not None:
-            if not self._eval_condition(task.condition, resolve):
+        resolve = lambda v: self._resolve(v, results, args, inst)
+        for expr in task.conditions:          # ALL nested conditions hold
+            if not self._eval_condition(expr, resolve):
                 raise _Skip("condition false")
 
         # resolve inputs
@@ -283,12 +284,12 @@ class LocalRunner:
                     kwargs[pname] = spec.defaults[pname]
                 else:
                     raise TypeError(
-                        f"{task.name}: missing argument {pname!r}")
+                        f"{inst.name}: missing argument {pname!r}")
             else:
                 art = resolve(task.arguments[pname])
                 if not isinstance(art, dsl.Artifact):
                     raise TypeError(
-                        f"{task.name}: input {pname!r} expects an artifact")
+                        f"{inst.name}: input {pname!r} expects an artifact")
                 kwargs[pname] = art
                 input_artifacts[pname] = art
 
@@ -299,12 +300,12 @@ class LocalRunner:
             if cached is not None:
                 result.outputs = cached
                 result.state = TaskState.CACHED
-                self._record(task, context_id, kwargs, input_artifacts,
+                self._record(inst, context_id, kwargs, input_artifacts,
                              cached, "CACHED", result)
                 return
 
         # create output artifacts
-        task_dir = os.path.join(run_dir, task.name.replace("/", "_"))
+        task_dir = os.path.join(run_dir, inst.name.replace("/", "_"))
         os.makedirs(task_dir, exist_ok=True)
         for oname, otype in spec.output_artifacts.items():
             cls = dsl.ARTIFACT_TYPES.get(otype, dsl.Artifact)
@@ -314,6 +315,7 @@ class LocalRunner:
         # execute with retries
         result.state = TaskState.RUNNING
         last_err: Optional[Exception] = None
+        ret = None
         for attempt in range(spec.retries + 1):
             result.attempts = attempt + 1
             try:
@@ -323,7 +325,7 @@ class LocalRunner:
             except Exception as e:
                 last_err = e
         if last_err is not None:
-            self._record(task, context_id, kwargs, input_artifacts, {},
+            self._record(inst, context_id, kwargs, input_artifacts, {},
                          "FAILED", result)
             raise last_err
 
@@ -335,28 +337,26 @@ class LocalRunner:
         result.state = TaskState.SUCCEEDED
         if spec.cache_enabled:
             self._cache_put(fingerprint, outputs)
-        self._record(task, context_id, kwargs, input_artifacts, outputs,
+        self._record(inst, context_id, kwargs, input_artifacts, outputs,
                      "COMPLETE", result)
 
     # ---------------------------------------------------- resolution ----
 
-    def _resolve(self, v, results, args, task, loop_of):
+    def _resolve(self, v, results, args, inst: _Instance):
         if isinstance(v, dsl.ParamRef):
             return args[v.name]
         if isinstance(v, dsl.OutputRef):
-            inst = self._ref_instance(v.task, task, results,
-                                      loop_of.get(task.name))
-            dep = results[inst]
+            dep_name = self._bind(v.task, inst, inst.base_loops)
+            dep = results[dep_name]
             if v.output not in dep.outputs:
                 raise KeyError(
-                    f"task {inst!r} has no output {v.output!r}")
+                    f"task {dep_name!r} has no output {v.output!r}")
             return dep.outputs[v.output]
         if isinstance(v, dsl.LoopItemRef):
-            loop_item = loop_of.get(task.name)
-            if loop_item is None or loop_item[0] != v.loop_id:
+            if v.loop_id not in inst.loop_items:
                 raise RuntimeError(
-                    f"{task.name}: loop item reference outside its loop")
-            item = loop_item[1]
+                    f"{inst.name}: loop item reference outside its loop")
+            item = inst.loop_items[v.loop_id]
             return item[v.field] if v.field else item
         return v
 
@@ -401,7 +401,9 @@ class LocalRunner:
             for root, _, files in sorted(os.walk(art.uri)):
                 for fname in sorted(files):
                     p = os.path.join(root, fname)
-                    h.update(fname.encode())
+                    # hash the path RELATIVE to the artifact root, so the
+                    # same bytes under a different layout digest differently
+                    h.update(os.path.relpath(p, art.uri).encode())
                     with open(p, "rb") as f:
                         h.update(f.read())
         h.update(json.dumps(art.metadata, sort_keys=True).encode())
@@ -426,6 +428,11 @@ class LocalRunner:
         return outputs
 
     def _cache_put(self, fingerprint: str, outputs: dict[str, Any]) -> None:
+        # all-or-nothing: a partial entry (e.g. missing an unserializable
+        # return value) would poison every future cache hit
+        for v in outputs.values():
+            if not isinstance(v, dsl.Artifact) and not _jsonable(v):
+                return
         entry = os.path.join(self.cache_dir, fingerprint)
         tmp = entry + ".tmp"
         shutil.rmtree(tmp, ignore_errors=True)
@@ -441,10 +448,6 @@ class LocalRunner:
                 meta[name] = {"kind": "artifact", "type": type(v).TYPE,
                               "metadata": v.metadata}
             else:
-                try:
-                    json.dumps(v)
-                except TypeError:
-                    continue        # unserializable return: don't cache it
                 meta[name] = {"kind": "value", "value": v}
         with open(os.path.join(tmp, "outputs.json"), "w") as f:
             json.dump(meta, f)
@@ -456,11 +459,11 @@ class LocalRunner:
 
     # ----------------------------------------------------- metadata ----
 
-    def _record(self, task, context_id, kwargs, input_artifacts, outputs,
+    def _record(self, inst, context_id, kwargs, input_artifacts, outputs,
                 state, result) -> None:
-        spec = task.component.spec
+        spec = inst.task.component.spec
         eid = self.metadata.put_execution(
-            type=spec.name, name=task.name, state=state,
+            type=spec.name, name=inst.name, state=state,
             properties={k: v for k, v in kwargs.items()
                         if not isinstance(v, dsl.Artifact)
                         and _jsonable(v)})

@@ -155,15 +155,24 @@ def lm_loss_fn(forward, cfg):
     Batch: {"tokens": [B, S+1] int32, "mask": optional [B, S+1]}.
     """
 
+    moe = bool(getattr(cfg, "n_experts", 0))
+
     def loss_fn(params, batch):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = forward(params, inputs, cfg)
+        if moe:
+            logits, fwd_aux = forward(params, inputs, cfg, return_aux=True)
+        else:
+            logits = forward(params, inputs, cfg)
         mask = batch.get("mask")
         mask = mask[:, 1:] if mask is not None else None
         loss, aux = softmax_cross_entropy(
             logits, targets, mask, z_loss=getattr(cfg, "z_loss", 0.0)
         )
-        return loss, {"tokens": aux["total_weight"]}
+        metrics = {"tokens": aux["total_weight"]}
+        if moe:
+            loss = loss + fwd_aux["moe_aux"]
+            metrics["moe_aux"] = fwd_aux["moe_aux"]
+        return loss, metrics
 
     return loss_fn

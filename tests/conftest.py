@@ -37,3 +37,9 @@ def mesh8():
 @pytest.fixture(scope="session")
 def mesh_fsdp8():
     return build_mesh(MeshConfig(fsdp=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_expert():
+    """data=2 x expert=4 mesh for MoE expert-parallel tests."""
+    return build_mesh(MeshConfig(data=2, fsdp=1, expert=4))

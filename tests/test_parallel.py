@@ -19,7 +19,8 @@ def test_mesh_resolution():
 
 def test_build_mesh_axes():
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
-    assert mesh.shape == {"data": 2, "fsdp": 2, "expert": 1, "context": 1, "tensor": 2}
+    assert mesh.shape == {"pipeline": 1, "data": 2, "fsdp": 2, "expert": 1,
+                          "context": 1, "tensor": 2}
     assert len(mesh.devices.flatten()) == 8
 
 

@@ -84,7 +84,7 @@ def test_component_outside_pipeline_raises():
 def test_trace_builds_graph():
     ctx = train_pipeline.trace()
     assert set(ctx.tasks) == {"make_data", "train", "deploy"}
-    assert ctx.tasks["deploy"].condition is not None
+    assert len(ctx.tasks["deploy"].conditions) == 1
 
 
 # ------------------------------------------------------------- compiler ----
@@ -111,7 +111,7 @@ def test_compiler_writes_package(tmp_path):
     tasks = ir["root"]["dag"]["tasks"]
     assert tasks["train"]["inputs"]["data"]["taskOutput"] == {
         "task": "make_data", "output": "data"}
-    assert tasks["deploy"]["triggerCondition"]["op"] == "<"
+    assert tasks["deploy"]["triggerConditions"][0]["op"] == "<"
 
 
 # --------------------------------------------------------------- runner ----
@@ -213,6 +213,103 @@ def test_parallel_for(tmp_path):
     assert res.succeeded
     got = sorted(res.task(f"use[{i}]").outputs["Output"] for i in range(3))
     assert got == [11, 21, 31]
+
+
+def test_nested_conditions_all_apply(tmp_path):
+    """A task under two Conditions runs only when BOTH hold."""
+    @component(cache=False)
+    def val() -> float:
+        return 0.0
+
+    @component(cache=False)
+    def guarded() -> str:
+        return "ran"
+
+    @pipeline
+    def nested():
+        v = val()
+        with Condition(v.output > 5.0):          # false
+            with Condition(v.output >= 0.0):     # true
+                guarded()
+
+    res = LocalRunner(str(tmp_path)).run(nested)
+    assert res.task("guarded").state == TaskState.SKIPPED
+
+
+def test_nested_parallel_for_cross_product(tmp_path):
+    @component(cache=False)
+    def combine(a: str, b: int) -> str:
+        return f"{a}{b}"
+
+    @pipeline
+    def nested(outer: list = None, inner: list = None):
+        with ParallelFor(outer) as a:
+            with ParallelFor(inner) as b:
+                combine(a=a, b=b)
+
+    res = LocalRunner(str(tmp_path)).run(
+        nested, arguments={"outer": ["x", "y"], "inner": [1, 2]})
+    assert res.succeeded
+    got = sorted(t.outputs["Output"] for n, t in res.tasks.items()
+                 if n.startswith("combine"))
+    assert got == ["x1", "x2", "y1", "y2"]
+
+
+def test_aggregation_over_loop_rejected(tmp_path):
+    @component(cache=False)
+    def work(x: int) -> int:
+        return x
+
+    @component(cache=False)
+    def agg(y: int) -> int:
+        return y
+
+    @pipeline
+    def bad(items: list = None):
+        with ParallelFor(items) as item:
+            w = work(x=item)
+        agg(y=w.output)                      # outside the loop
+
+    with pytest.raises(NotImplementedError):
+        LocalRunner(str(tmp_path)).run(bad, arguments={"items": [1, 2]})
+
+
+def test_none_default_parameter_allowed(tmp_path):
+    @component(cache=False)
+    def show(x: str = "d") -> str:
+        return str(x)
+
+    @pipeline
+    def p(x: str = None):
+        show(x=x)
+
+    res = LocalRunner(str(tmp_path)).run(p)   # no args: None default is fine
+    assert res.succeeded
+    assert res.task("show").outputs["Output"] == "None"
+
+
+def test_unserializable_output_not_poisoned_in_cache(tmp_path):
+    class Weird:
+        pass
+
+    @component
+    def make() -> object:
+        return Weird()
+
+    @component(cache=False)
+    def use(o: object) -> str:
+        return type(o).__name__
+
+    @pipeline
+    def p():
+        use(o=make().output)
+
+    runner = LocalRunner(str(tmp_path))
+    r1 = runner.run(p)
+    assert r1.succeeded
+    r2 = runner.run(p)                        # must NOT hit a poisoned entry
+    assert r2.succeeded
+    assert r2.task("make").state == TaskState.SUCCEEDED   # re-ran, not CACHED
 
 
 def test_lineage_recorded(tmp_path):

@@ -1,0 +1,214 @@
+"""Scale-push tests: pipeline parallelism, MoE/expert parallelism, the MoE
+Llama variant training end-to-end on the virtual mesh, and the hybrid
+multi-slice mesh construction (SURVEY.md §2.7 PP/EP/multi-slice rows)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.parallel import (
+    MeshConfig, MoEConfig, build_mesh, init_moe_params, moe_layer,
+    pipeline_apply, stack_stage_params,
+)
+
+
+# ---------------------------------------------------------------- pipeline
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    return build_mesh(MeshConfig(pipeline=4))      # fsdp absorbs the rest
+
+
+def _mlp_stages(n_stages, dim, key):
+    stages = []
+    for _ in range(n_stages):
+        k1, k2, key = jax.random.split(key, 3)
+        stages.append({"w": jax.random.normal(k1, (dim, dim)) * 0.5,
+                       "b": jax.random.normal(k2, (dim,)) * 0.1})
+    return stages
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def test_pipeline_matches_sequential(pipe_mesh):
+    stages = _mlp_stages(4, 16, jax.random.key(0))
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(1), (8, 16))
+    fwd = jax.jit(pipeline_apply(_stage_fn, pipe_mesh, microbatches=4))
+    y = fwd(stacked, x)
+    ref = x
+    for p in stages:
+        ref = _stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_grads_reach_every_stage(pipe_mesh):
+    stages = _mlp_stages(4, 16, jax.random.key(2))
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(3), (8, 16))
+    fwd = pipeline_apply(_stage_fn, pipe_mesh, microbatches=2)
+    g = jax.jit(jax.grad(lambda p, x: jnp.sum(fwd(p, x) ** 2)))(stacked, x)
+    per_stage = np.asarray(jnp.abs(g["w"]).sum(axis=(1, 2)))
+    assert (per_stage > 0).all(), per_stage
+
+
+def test_pipeline_microbatch_count_must_divide(pipe_mesh):
+    stages = _mlp_stages(4, 8, jax.random.key(4))
+    stacked = stack_stage_params(stages)
+    x = jnp.zeros((6, 8))
+    fwd = pipeline_apply(_stage_fn, pipe_mesh, microbatches=4)
+    with pytest.raises(Exception):
+        jax.jit(fwd)(stacked, x)      # 6 % 4 != 0
+
+
+# ---------------------------------------------------------------- moe
+
+def test_moe_matches_per_token_reference():
+    cfg = MoEConfig(dim=16, mlp_dim=32, n_experts=4, top_k=2,
+                    capacity_factor=8.0)
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 6, 16))
+    y, aux = jax.jit(lambda p, x: moe_layer(p, x, cfg))(params, x)
+    assert float(aux["moe_dropped_fraction"]) == 0.0
+
+    tokens = np.asarray(x.reshape(-1, 16), np.float32)
+    probs = np.asarray(jax.nn.softmax(
+        jnp.asarray(tokens @ np.asarray(params["router"], np.float32)), -1))
+    ref = np.zeros_like(tokens)
+    for t in range(tokens.shape[0]):
+        idx = np.argsort(-probs[t])[:2]
+        w = probs[t][idx] / probs[t][idx].sum()
+        for wi, ei in zip(w, idx):
+            h = np.asarray(jax.nn.silu(jnp.asarray(
+                tokens[t] @ np.asarray(params["w_gate"][ei]))))
+            h = h * (tokens[t] @ np.asarray(params["w_up"][ei]))
+            ref[t] += wi * (h @ np.asarray(params["w_down"][ei]))
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 16), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_sharded_matches_unsharded():
+    cfg = MoEConfig(dim=16, mlp_dim=32, n_experts=4, top_k=2,
+                    capacity_factor=8.0)
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 6, 16))
+    y, _ = jax.jit(lambda p, x: moe_layer(p, x, cfg))(params, x)
+    mesh = build_mesh(MeshConfig(expert=4, fsdp=1, data=2))
+    with mesh:
+        y2, _ = jax.jit(lambda p, x: moe_layer(p, x, cfg))(params, x)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_capacity_drops_overflow():
+    cfg = MoEConfig(dim=16, mlp_dim=32, n_experts=4, top_k=1,
+                    capacity_factor=0.26)
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 6, 16))
+    _, aux = jax.jit(lambda p, x: moe_layer(p, x, cfg))(params, x)
+    assert float(aux["moe_dropped_fraction"]) > 0
+
+
+def test_moe_aux_losses_differentiable():
+    cfg = MoEConfig(dim=8, mlp_dim=16, n_experts=4, top_k=2)
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (1, 8, 8))
+
+    def loss(p):
+        y, aux = moe_layer(p, x, cfg)
+        return jnp.sum(y ** 2) + aux["moe_load_balance"] + aux["moe_router_z"]
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert float(jnp.abs(g["router"]).sum()) > 0    # router learns
+
+
+# ---------------------------------------------------------------- moe llama
+
+def test_llama_moe_trains(mesh8):
+    cfg = llama.llama_tiny(n_experts=4, moe_top_k=2,
+                           moe_capacity_factor=4.0, dtype=jnp.float32)
+    from kubeflow_tpu.training import (
+        Trainer, TrainerConfig, lm_loss_fn, put_batch, synthetic_lm_batches,
+    )
+
+    trainer = Trainer(
+        mesh=mesh8,
+        init_params_fn=lambda rng: llama.init_params(rng, cfg),
+        params_logical_axes=llama.param_logical_axes(cfg),
+        loss_fn=lm_loss_fn(llama.forward, cfg),
+        config=TrainerConfig(learning_rate=3e-3, warmup_steps=2,
+                             total_steps=50),
+    )
+    trainer.init_state(jax.random.key(0))
+    batch = put_batch(mesh8, next(iter(
+        synthetic_lm_batches(cfg.vocab_size, 8, 32))))
+    first = None
+    for _ in range(12):
+        m = trainer.train_step(batch)
+        first = first if first is not None else float(m["loss"])
+    assert float(m["loss"]) < first          # MoE model actually learns
+    assert "moe_aux" in m
+
+
+def test_llama_moe_decode_matches_forward():
+    cfg = llama.llama_tiny(n_experts=4, moe_top_k=2,
+                           moe_capacity_factor=8.0, dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = [5, 6, 7, 8]
+    cache = llama.init_cache(cfg, 1, 32)
+    logits, cache = llama.prefill(
+        params, jnp.asarray([prompt], jnp.int32), cfg, cache)
+    toks = [int(jnp.argmax(logits[0]))]
+    for _ in range(3):
+        logits, cache = llama.decode_step(
+            params, jnp.asarray(toks[-1:], jnp.int32), cfg, cache)
+        toks.append(int(jnp.argmax(logits[0])))
+
+    ref = list(prompt)
+    for _ in range(4):
+        full = llama.forward(params, jnp.asarray([ref], jnp.int32), cfg)
+        ref.append(int(jnp.argmax(full[0, -1])))
+    assert toks == ref[len(prompt):]
+
+
+def test_moe_expert_sharded_training(mesh_expert):
+    cfg = llama.llama_tiny(n_experts=4, moe_top_k=2,
+                           moe_capacity_factor=4.0, dtype=jnp.float32)
+    from kubeflow_tpu.training import (
+        Trainer, TrainerConfig, lm_loss_fn, put_batch, synthetic_lm_batches,
+    )
+
+    trainer = Trainer(
+        mesh=mesh_expert,
+        init_params_fn=lambda rng: llama.init_params(rng, cfg),
+        params_logical_axes=llama.param_logical_axes(cfg),
+        loss_fn=lm_loss_fn(llama.forward, cfg),
+        config=TrainerConfig(learning_rate=3e-3, warmup_steps=2,
+                             total_steps=20),
+    )
+    trainer.init_state(jax.random.key(0))
+    batch = put_batch(mesh_expert, next(iter(
+        synthetic_lm_batches(cfg.vocab_size, 8, 32))))
+    m = trainer.train_step(batch)
+    assert float(m["loss"]) > 0
+
+
+# ---------------------------------------------------------------- mesh
+
+def test_hybrid_multislice_mesh_shapes():
+    """2 slices of 4 devices: DCN data outer, ICI inner axes."""
+    cfg = MeshConfig(data=1, fsdp=2, tensor=2, dcn_data=2)
+    mesh = build_mesh(cfg)
+    assert dict(mesh.shape)["data"] == 2        # dcn * ici data merged
+    assert dict(mesh.shape)["fsdp"] == 2
+    assert dict(mesh.shape)["tensor"] == 2
+
+
+def test_mesh_rejects_bad_pipeline_factor():
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(pipeline=3, fsdp=1))
