@@ -1,0 +1,64 @@
+"""Heartbeat-based failure detection (SURVEY.md §2.8 fault signaling, §5
+failure detection): workers beat a liveness file (training.loop.Heartbeat);
+the controller tracks staleness and fails stale pods so the gang-restart +
+checkpoint-resume path kicks in. Catches hangs that exit codes never
+surface (a wedged collective keeps the process alive forever)."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from kubeflow_tpu.controller.cluster import PodPhase
+from kubeflow_tpu.controller.reconciler import JobController
+
+
+class FileHeartbeatTracker:
+    """Reads worker heartbeat files; a pod whose file mtime is older than
+    ``timeout_s`` (or missing past the grace window) is stale."""
+
+    def __init__(self, heartbeat_dir: str, timeout_s: float = 120.0,
+                 startup_grace_s: float = 300.0):
+        self.dir = heartbeat_dir
+        self.timeout_s = timeout_s
+        self.startup_grace_s = startup_grace_s
+        os.makedirs(heartbeat_dir, exist_ok=True)
+
+    def path_for(self, job_name: str, pod_name: str) -> str:
+        return os.path.join(self.dir, f"{job_name}-{pod_name}.hb")
+
+    def is_stale(self, job_name: str, pod_name: str,
+                 pod_started_at: float,
+                 now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        path = self.path_for(job_name, pod_name)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            # never beat: stale only after the startup grace window
+            return now - pod_started_at > self.startup_grace_s
+        return age > self.timeout_s
+
+
+def check_heartbeats(controller: JobController, namespace: str, name: str,
+                     tracker: FileHeartbeatTracker,
+                     now: Optional[float] = None) -> list[str]:
+    """Fail pods with stale heartbeats; the next reconcile turns any failure
+    into a gang restart (ICI worlds can't lose a member). Returns the stale
+    pod names."""
+    job = controller.get(namespace, name)
+    if job is None or job.status.is_finished():
+        return []
+    stale = []
+    for pod in controller.cluster.list_pods(
+            namespace, {"job-name": name, "job-uid": job.uid}):
+        if pod is None or pod.phase != PodPhase.RUNNING:
+            continue
+        if tracker.is_stale(name, pod.name, pod.created_at, now=now):
+            pod.phase = PodPhase.FAILED
+            pod.exit_code = -1          # signal-ish: retryable
+            stale.append(pod.name)
+    if stale:
+        controller.reconcile(namespace, name)
+    return stale

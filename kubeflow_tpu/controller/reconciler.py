@@ -46,11 +46,14 @@ class JobController:
     """Reconciles JobSpecs against a Cluster. Also plays the apiserver role:
     `submit`/`get`/`delete` mutate the job store, `reconcile` converges it."""
 
-    def __init__(self, cluster: Cluster, scheduler: Optional[GangScheduler] = None):
+    def __init__(self, cluster: Cluster, scheduler: Optional[GangScheduler] = None,
+                 pod_mutator=None):
         self.cluster = cluster
         self.scheduler = scheduler or GangScheduler()
         self.jobs: dict[tuple[str, str], JobSpec] = {}
         self.metrics: dict[str, float] = {}   # controller-level observability
+        # admission hook (PodDefaults registry / webhook equivalent)
+        self.pod_mutator = pod_mutator
 
     # ---------------- apiserver-ish surface ----------------
 
@@ -154,13 +157,16 @@ class JobController:
                 if self.cluster.get_pod(job.namespace, name) is None:
                     env = self.cluster_env(job, rtype, i)
                     env.update(spec.template.env)
-                    self.cluster.create_pod(Pod(
+                    pod = Pod(
                         name=name, namespace=job.namespace,
                         labels={**_job_selector(job), "replica-type": rtype,
                                 "replica-index": str(i)},
                         env=env,
                         command=list(spec.template.command),
-                    ))
+                    )
+                    if self.pod_mutator is not None:
+                        pod = self.pod_mutator(pod)
+                    self.cluster.create_pod(pod)
 
     def _start_admitted(self, job: JobSpec) -> None:
         admitted = (

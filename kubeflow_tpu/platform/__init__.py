@@ -1,0 +1,23 @@
+"""Platform shell — multi-tenancy, pod defaults, notebooks, dashboard,
+install manifests (SURVEY.md §2.6, §7 step 8)."""
+
+from kubeflow_tpu.platform.dashboard import Dashboard
+from kubeflow_tpu.platform.manifests import (
+    overlay_images, overlay_replicas, render_platform,
+    tpu_worker_pod_template,
+)
+from kubeflow_tpu.platform.notebooks import (
+    Notebook, NotebookController, TensorBoard, TensorBoardController,
+)
+from kubeflow_tpu.platform.poddefaults import PodDefault, PodDefaultsRegistry
+from kubeflow_tpu.platform.profiles import (
+    Profile, ProfileController, QuotaExceeded, ResourceQuota, Role,
+)
+
+__all__ = [
+    "Dashboard", "Notebook", "NotebookController", "PodDefault",
+    "PodDefaultsRegistry", "Profile", "ProfileController", "QuotaExceeded",
+    "ResourceQuota", "Role", "TensorBoard", "TensorBoardController",
+    "overlay_images", "overlay_replicas", "render_platform",
+    "tpu_worker_pod_template",
+]

@@ -1,0 +1,115 @@
+"""Central dashboard — thin status aggregation over all controllers
+(SURVEY.md §2.6 centraldashboard, reduced to its capability: one place that
+lists everything a user owns, JSON + minimal HTML, namespace-scoped by the
+profile access rules)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class Dashboard:
+    """Aggregates controllers; access-checked by a ProfileController."""
+
+    def __init__(self, *, jobs=None, experiments=None, serving=None,
+                 pipelines=None, notebooks=None, profiles=None):
+        self.jobs = jobs
+        self.experiments = experiments
+        self.serving = serving
+        self.pipelines = pipelines
+        self.notebooks = notebooks
+        self.profiles = profiles
+
+    def snapshot(self, user: Optional[str] = None) -> dict:
+        """Everything visible to `user` (all namespaces when user is None
+        or no profile controller is wired)."""
+        allowed = None
+        if user is not None and self.profiles is not None:
+            allowed = set(self.profiles.namespaces_for(user))
+
+        def visible(ns: str) -> bool:
+            return allowed is None or ns in allowed
+
+        out: dict = {"namespaces": sorted(allowed) if allowed else "all"}
+        if self.jobs is not None:
+            out["jobs"] = [
+                {"namespace": ns, "name": name,
+                 "kind": job.kind,
+                 "state": (job.status.condition().value
+                           if job.status.condition() else "Pending"),
+                 "restarts": job.status.restart_count}
+                for (ns, name), job in sorted(self.jobs.jobs.items())
+                if visible(ns)
+            ]
+        if self.experiments is not None:
+            out["experiments"] = [
+                {"name": e.name,
+                 "trials": len(e.trials),
+                 "best": (e.best_trial.objective_value
+                          if e.best_trial else None),
+                 "done": e.succeeded or e.failed}
+                for e in self.experiments if visible(e.namespace)
+            ]
+        if self.serving is not None:
+            out["inference_services"] = [
+                {"namespace": ns, "name": name,
+                 "ready": isvc.status.ready,
+                 "traffic": isvc.status.traffic}
+                for (ns, name), isvc in sorted(self.serving.services.items())
+                if visible(ns)
+            ]
+        if self.pipelines is not None:
+            out["pipeline_runs"] = [
+                {"run_id": r.run_id, "state": r.state.value}
+                for r in self.pipelines.list_runs()
+            ]
+        if self.notebooks is not None:
+            out["notebooks"] = [
+                {"namespace": ns, "name": name, "stopped": nb.stopped}
+                for (ns, name), nb in sorted(
+                    self.notebooks.notebooks.items())
+                if visible(ns)
+            ]
+        return out
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                user = (parse_qs(parsed.query).get("user") or [None])[0]
+                if parsed.path == "/api/snapshot":
+                    body = json.dumps(outer.snapshot(user)).encode()
+                    ctype = "application/json"
+                elif parsed.path in ("/", "/index.html"):
+                    snap = outer.snapshot(user)
+                    rows = "".join(
+                        f"<h2>{k}</h2><pre>{json.dumps(v, indent=1)}</pre>"
+                        for k, v in snap.items())
+                    body = (f"<html><title>kubeflow-tpu</title><body>"
+                            f"<h1>kubeflow-tpu dashboard</h1>{rows}"
+                            f"</body></html>").encode()
+                    ctype = "text/html"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        server.daemon_threads = True
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server

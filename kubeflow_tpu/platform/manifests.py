@@ -1,0 +1,209 @@
+"""Install manifests — the `kubectl apply -k example/` equivalent
+(SURVEY.md §2.6 'manifests distribution', §3.5 bring-up): render the whole
+platform as Kubernetes YAML with ZERO GPU dependencies (BASELINE.md: no
+NVIDIA device plugin / runtime class anywhere in the default install).
+
+``render_platform()`` returns the multi-doc YAML; overlays mutate the base
+(kustomize-style patches) without touching it.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+import yaml
+
+API_GROUP = "kubeflow-tpu.org"
+VERSION = "v1"
+
+CRD_KINDS = [
+    ("jaxjobs", "JAXJob"),
+    ("tfjobs", "TFJob"),
+    ("experiments", "Experiment"),
+    ("trials", "Trial"),
+    ("inferenceservices", "InferenceService"),
+    ("servingruntimes", "ServingRuntime"),
+    ("inferencegraphs", "InferenceGraph"),
+    ("trainedmodels", "TrainedModel"),
+    ("pipelines", "Pipeline"),
+    ("pipelineruns", "PipelineRun"),
+    ("recurringruns", "RecurringRun"),
+    ("profiles", "Profile"),
+    ("poddefaults", "PodDefault"),
+    ("notebooks", "Notebook"),
+    ("tensorboards", "TensorBoard"),
+]
+
+CONTROLLERS = [
+    # (name, image, args, needs_webhook)
+    ("training-controller", "kubeflow-tpu/controller:latest",
+     ["--enable-kind=JAXJob", "--enable-kind=TFJob",
+      "--gang-scheduler=builtin"], True),
+    ("hpo-controller", "kubeflow-tpu/controller:latest",
+     ["--enable-kind=Experiment"], True),
+    ("serving-controller", "kubeflow-tpu/controller:latest",
+     ["--enable-kind=InferenceService"], True),
+    ("pipelines-apiserver", "kubeflow-tpu/pipelines:latest", [], False),
+    ("metadata-store", "kubeflow-tpu/metadata-store:latest",
+     ["--port", "8081", "--wal", "/data/metadata.wal"], False),
+    ("dashboard", "kubeflow-tpu/dashboard:latest", [], False),
+]
+
+
+def crd(plural: str, kind: str) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{API_GROUP}"},
+        "spec": {
+            "group": API_GROUP,
+            "names": {"kind": kind, "plural": plural,
+                      "singular": kind.lower()},
+            "scope": "Namespaced" if kind != "Profile" else "Cluster",
+            "versions": [{
+                "name": VERSION, "served": True, "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True,
+                }},
+            }],
+        },
+    }
+
+
+def deployment(name: str, image: str, args: list[str],
+               namespace: str = "kubeflow-tpu") -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {"app": name}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "serviceAccountName": name,
+                    "containers": [{
+                        "name": name,
+                        "image": image,
+                        "args": list(args),
+                        "ports": [{"containerPort": 8080, "name": "metrics"}],
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "256Mi"},
+                            "limits": {"cpu": "2", "memory": "2Gi"},
+                        },
+                    }],
+                },
+            },
+        },
+    }
+
+
+def service(name: str, port: int = 8080,
+            namespace: str = "kubeflow-tpu") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"selector": {"app": name},
+                 "ports": [{"port": port, "targetPort": port}]},
+    }
+
+
+def rbac(name: str, namespace: str = "kubeflow-tpu") -> list[dict]:
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": name, "namespace": namespace}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRole",
+         "metadata": {"name": name},
+         "rules": [
+             {"apiGroups": [API_GROUP], "resources": ["*"],
+              "verbs": ["*"]},
+             {"apiGroups": [""],
+              "resources": ["pods", "services", "events", "configmaps"],
+              "verbs": ["*"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": name},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole", "name": name},
+         "subjects": [{"kind": "ServiceAccount", "name": name,
+                       "namespace": namespace}]},
+    ]
+
+
+def tpu_worker_pod_template(accelerator: str = "v5p",
+                            topology: str = "2x2x1") -> dict:
+    """The GKE TPU scheduling contract (BASELINE.md): topology node
+    selectors + google.com/tpu resource — never nvidia.com/gpu."""
+    return {
+        "nodeSelector": {
+            "cloud.google.com/gke-tpu-accelerator": f"tpu-{accelerator}",
+            "cloud.google.com/gke-tpu-topology": topology,
+        },
+        "containers": [{
+            "name": "worker",
+            "resources": {"limits": {"google.com/tpu": "4"},
+                          "requests": {"google.com/tpu": "4"}},
+        }],
+    }
+
+
+def render_platform(namespace: str = "kubeflow-tpu",
+                    overlays: Optional[list] = None) -> str:
+    """The single-apply install document. ``overlays`` are callables
+    mutating the doc list (kustomize-patch equivalents)."""
+    docs: list[dict] = [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": namespace}},
+    ]
+    for plural, kind in CRD_KINDS:
+        docs.append(crd(plural, kind))
+    for name, image, args, _webhook in CONTROLLERS:
+        docs.extend(rbac(name, namespace))
+        docs.append(deployment(name, image, args, namespace))
+        docs.append(service(name, 8080, namespace))
+    docs = copy.deepcopy(docs)
+    for overlay in overlays or []:
+        overlay(docs)
+    _assert_no_gpu(docs)
+    return yaml.safe_dump_all(docs, sort_keys=False)
+
+
+def _assert_no_gpu(docs: list[dict]) -> None:
+    text = yaml.safe_dump_all(docs)
+    for needle in ("nvidia.com/gpu", "nvidia-device-plugin", "runtimeClass"):
+        if needle in text:
+            raise ValueError(
+                f"GPU dependency {needle!r} leaked into the TPU install")
+
+
+# ---------------------------------------------------------- overlays ----
+
+def overlay_images(mapping: dict[str, str]):
+    """Retag images (the kustomize `images:` transformer)."""
+
+    def apply(docs: list[dict]) -> None:
+        for doc in docs:
+            if doc.get("kind") != "Deployment":
+                continue
+            for c in doc["spec"]["template"]["spec"]["containers"]:
+                if c["image"] in mapping:
+                    c["image"] = mapping[c["image"]]
+
+    return apply
+
+
+def overlay_replicas(app: str, replicas: int):
+    def apply(docs: list[dict]) -> None:
+        for doc in docs:
+            if doc.get("kind") == "Deployment" and \
+                    doc["metadata"]["name"] == app:
+                doc["spec"]["replicas"] = replicas
+
+    return apply

@@ -1,0 +1,126 @@
+"""Notebook + TensorBoard controllers (SURVEY.md §2.6: notebook-controller
+with idle culling; tensorboard-controller as CRD -> viewer Deployment)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from kubeflow_tpu.controller.cluster import Cluster, Pod, Service
+
+
+@dataclasses.dataclass
+class Notebook:
+    name: str
+    namespace: str = "default"
+    image: str = "kubeflow-tpu/notebook:latest"
+    cpu: str = "2"
+    memory: str = "8Gi"
+    tpu_chips: int = 0
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    volumes: dict[str, str] = dataclasses.field(default_factory=dict)
+    # culling
+    cull_idle_seconds: Optional[float] = 3600.0
+    last_activity: float = dataclasses.field(default_factory=time.time)
+    stopped: bool = False
+
+
+@dataclasses.dataclass
+class TensorBoard:
+    name: str
+    namespace: str = "default"
+    logdir: str = ""
+    image: str = "kubeflow-tpu/tensorboard:latest"
+
+
+class NotebookController:
+    """Reconciles Notebooks into a pod+service each; culls idle ones by
+    stopping the pod (spec retained — restart on next activity)."""
+
+    def __init__(self, cluster: Cluster, pod_mutator=None):
+        self.cluster = cluster
+        self.notebooks: dict[tuple[str, str], Notebook] = {}
+        self.pod_mutator = pod_mutator
+
+    def apply(self, nb: Notebook) -> Notebook:
+        self.notebooks[(nb.namespace, nb.name)] = nb
+        self.reconcile(nb.namespace, nb.name)
+        return nb
+
+    def delete(self, namespace: str, name: str) -> None:
+        self.notebooks.pop((namespace, name), None)
+        self.cluster.delete_pod(namespace, f"notebook-{name}")
+        self.cluster.delete_service(namespace, f"notebook-{name}")
+
+    def reconcile(self, namespace: str, name: str) -> Optional[Notebook]:
+        nb = self.notebooks.get((namespace, name))
+        if nb is None:
+            return None
+        pod_name = f"notebook-{name}"
+        if nb.stopped:
+            self.cluster.delete_pod(namespace, pod_name)
+            return nb
+        if self.cluster.get_pod(namespace, pod_name) is None:
+            pod = Pod(
+                name=pod_name, namespace=namespace,
+                labels={"notebook": name, "app": "notebook"},
+                env=dict(nb.env), command=[],
+            )
+            if self.pod_mutator is not None:
+                pod = self.pod_mutator(pod)
+            self.cluster.create_pod(pod)
+        if self.cluster.get_service(namespace, pod_name) is None:
+            self.cluster.create_service(Service(
+                name=pod_name, namespace=namespace,
+                selector={"notebook": name}, port=8888))
+        return nb
+
+    def touch(self, namespace: str, name: str) -> None:
+        """Record user activity (resets the culling clock; restarts a
+        culled notebook)."""
+        nb = self.notebooks[(namespace, name)]
+        nb.last_activity = time.time()
+        if nb.stopped:
+            nb.stopped = False
+        self.reconcile(namespace, name)
+
+    def cull_idle(self, now: Optional[float] = None) -> list[str]:
+        """Stop notebooks idle past their cull window. Returns culled names."""
+        now = time.time() if now is None else now
+        culled = []
+        for nb in self.notebooks.values():
+            if nb.stopped or nb.cull_idle_seconds is None:
+                continue
+            if now - nb.last_activity > nb.cull_idle_seconds:
+                nb.stopped = True
+                self.reconcile(nb.namespace, nb.name)
+                culled.append(f"{nb.namespace}/{nb.name}")
+        return culled
+
+
+class TensorBoardController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.boards: dict[tuple[str, str], TensorBoard] = {}
+
+    def apply(self, tb: TensorBoard) -> TensorBoard:
+        self.boards[(tb.namespace, tb.name)] = tb
+        pod_name = f"tensorboard-{tb.name}"
+        if self.cluster.get_pod(tb.namespace, pod_name) is None:
+            self.cluster.create_pod(Pod(
+                name=pod_name, namespace=tb.namespace,
+                labels={"tensorboard": tb.name},
+                env={"TB_LOGDIR": tb.logdir},
+                command=["tensorboard", "--logdir", tb.logdir],
+            ))
+        if self.cluster.get_service(tb.namespace, pod_name) is None:
+            self.cluster.create_service(Service(
+                name=pod_name, namespace=tb.namespace,
+                selector={"tensorboard": tb.name}, port=6006))
+        return tb
+
+    def delete(self, namespace: str, name: str) -> None:
+        self.boards.pop((namespace, name), None)
+        self.cluster.delete_pod(namespace, f"tensorboard-{name}")
+        self.cluster.delete_service(namespace, f"tensorboard-{name}")

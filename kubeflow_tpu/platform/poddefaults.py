@@ -1,0 +1,47 @@
+"""PodDefaults — label-selected pod mutation (SURVEY.md §2.6
+admission-webhook: inject volumes/env/tolerations into matching pods; how
+notebooks and jobs pick up secrets and TPU settings without per-job spec
+plumbing)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.controller.cluster import Pod
+
+
+@dataclasses.dataclass
+class PodDefault:
+    name: str
+    namespace: str
+    selector: dict[str, str]               # pod labels that opt in
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    volumes: dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def matches(self, pod: Pod) -> bool:
+        if pod.namespace != self.namespace:
+            return False
+        return all(pod.labels.get(k) == v for k, v in self.selector.items())
+
+
+class PodDefaultsRegistry:
+    """Holds PodDefaults and applies them — the mutating-webhook role.
+    Controllers call ``mutate(pod)`` before creating pods (the JobController
+    takes this as its ``pod_mutator`` hook)."""
+
+    def __init__(self):
+        self._defaults: dict[tuple[str, str], PodDefault] = {}
+
+    def apply(self, pd: PodDefault) -> None:
+        self._defaults[(pd.namespace, pd.name)] = pd
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._defaults.pop((namespace, name), None)
+
+    def mutate(self, pod: Pod) -> Pod:
+        for pd in self._defaults.values():
+            if pd.matches(pod):
+                # pod's own values win over injected defaults
+                pod.env = {**pd.env, **pod.env}
+        return pod

@@ -1,0 +1,139 @@
+"""Profiles + access management — multi-tenancy (SURVEY.md §2.6).
+
+The reference's profile-controller + KFAM: a Profile owns a namespace,
+RBAC role bindings for its owner/contributors, and resource quotas. TPU
+twist: quotas meter TPU chips by topology (`google.com/tpu`), never GPUs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class Role(str, enum.Enum):
+    OWNER = "owner"
+    CONTRIBUTOR = "contributor"
+    VIEWER = "viewer"
+
+# capability sets per role (the RBAC ClusterRole equivalents)
+ROLE_VERBS = {
+    Role.OWNER: {"get", "list", "create", "update", "delete", "manage-access"},
+    Role.CONTRIBUTOR: {"get", "list", "create", "update", "delete"},
+    Role.VIEWER: {"get", "list"},
+}
+
+
+@dataclasses.dataclass
+class ResourceQuota:
+    cpu: Optional[str] = None
+    memory: Optional[str] = None
+    tpu_chips: Optional[int] = None        # google.com/tpu total
+    max_jobs: Optional[int] = None
+    max_notebooks: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Profile:
+    name: str                  # also the namespace name
+    owner: str                 # user email
+    quota: ResourceQuota = dataclasses.field(default_factory=ResourceQuota)
+    contributors: dict[str, Role] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Namespace:
+    name: str
+    labels: dict[str, str]
+    role_bindings: dict[str, Role]         # user -> role
+    quota: ResourceQuota
+
+
+class ProfileController:
+    """Reconciles Profiles into namespaces + bindings + quotas, and answers
+    access checks (the KFAM API role)."""
+
+    def __init__(self):
+        self.profiles: dict[str, Profile] = {}
+        self.namespaces: dict[str, Namespace] = {}
+
+    def apply(self, profile: Profile) -> Namespace:
+        self.profiles[profile.name] = profile
+        bindings = {profile.owner: Role.OWNER}
+        bindings.update(profile.contributors)
+        ns = Namespace(
+            name=profile.name,
+            labels={"kubeflow-tpu.org/profile": profile.name,
+                    "istio-injection": "enabled"},
+            role_bindings=bindings,
+            quota=profile.quota,
+        )
+        self.namespaces[profile.name] = ns
+        return ns
+
+    def delete(self, name: str) -> None:
+        self.profiles.pop(name, None)
+        self.namespaces.pop(name, None)
+
+    # ------------- KFAM-equivalent access API -------------
+
+    def add_contributor(self, profile: str, user: str,
+                        role: Role = Role.CONTRIBUTOR,
+                        requester: Optional[str] = None) -> None:
+        p = self.profiles[profile]
+        if requester is not None and not self.can(requester, profile,
+                                                  "manage-access"):
+            raise PermissionError(
+                f"{requester} cannot manage access on {profile}")
+        p.contributors[user] = role
+        self.apply(p)
+
+    def remove_contributor(self, profile: str, user: str,
+                           requester: Optional[str] = None) -> None:
+        p = self.profiles[profile]
+        if requester is not None and not self.can(requester, profile,
+                                                  "manage-access"):
+            raise PermissionError(
+                f"{requester} cannot manage access on {profile}")
+        p.contributors.pop(user, None)
+        self.apply(p)
+
+    def can(self, user: str, namespace: str, verb: str) -> bool:
+        ns = self.namespaces.get(namespace)
+        if ns is None:
+            return False
+        role = ns.role_bindings.get(user)
+        return role is not None and verb in ROLE_VERBS[role]
+
+    def namespaces_for(self, user: str) -> list[str]:
+        return sorted(
+            ns.name for ns in self.namespaces.values()
+            if user in ns.role_bindings
+        )
+
+    # ------------- quota checks -------------
+
+    def check_quota(self, namespace: str, *, tpu_chips: int = 0,
+                    jobs_running: int = 0, notebooks_running: int = 0,
+                    new_jobs: int = 0, new_notebooks: int = 0,
+                    new_tpu_chips: int = 0) -> None:
+        ns = self.namespaces.get(namespace)
+        if ns is None:
+            return
+        q = ns.quota
+        if q.tpu_chips is not None and tpu_chips + new_tpu_chips > q.tpu_chips:
+            raise QuotaExceeded(
+                f"{namespace}: TPU chip quota {q.tpu_chips} exceeded "
+                f"({tpu_chips}+{new_tpu_chips})")
+        if q.max_jobs is not None and jobs_running + new_jobs > q.max_jobs:
+            raise QuotaExceeded(
+                f"{namespace}: job quota {q.max_jobs} exceeded")
+        if q.max_notebooks is not None and \
+                notebooks_running + new_notebooks > q.max_notebooks:
+            raise QuotaExceeded(
+                f"{namespace}: notebook quota {q.max_notebooks} exceeded")
+
+
+class QuotaExceeded(RuntimeError):
+    pass

@@ -1,0 +1,124 @@
+"""High-level training driver: checkpoint auto-resume, metrics export,
+heartbeats, profiler toggle.
+
+This is the recovery path SURVEY.md §5 makes first-class: slice restart is
+the NORMAL failure mode at scale, so every run is structured as
+restore-latest -> train -> periodic async save, and a restarted job resumes
+where it left off with no operator involvement beyond re-running the pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+
+from kubeflow_tpu.training.checkpoint import CheckpointManager
+from kubeflow_tpu.training.metrics import MetricsWriter
+from kubeflow_tpu.training.trainer import Trainer
+
+
+@dataclasses.dataclass
+class FitResult:
+    final_step: int
+    resumed_from: Optional[int]
+    last_metrics: dict
+
+
+class Heartbeat:
+    """Liveness file: mtime is the signal, content is the last step. The
+    controller-side FileHeartbeatTracker reads these (SURVEY.md §2.8 fault
+    signaling: heartbeat loss => job-level restart)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def beat(self, step: int) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(step))
+        os.replace(tmp, self.path)
+
+
+def fit(
+    trainer: Trainer,
+    batches: Iterable[Any],
+    *,
+    rng: jax.Array,
+    max_steps: int,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 100,
+    metrics: Optional[MetricsWriter] = None,
+    metrics_every: int = 10,
+    heartbeat: Optional[Heartbeat] = None,
+    profile_dir: Optional[str] = None,
+    profile_steps: tuple[int, int] = (10, 20),
+    on_step: Optional[Callable[[int, dict], None]] = None,
+) -> FitResult:
+    """Run training with auto-resume.
+
+    If ``checkpoint_dir`` holds a checkpoint, state is restored and training
+    continues from the saved step; otherwise state is initialized from
+    ``rng``. Batches are consumed from the iterator either way (callers
+    should seed/skip data deterministically if exact data order matters).
+    """
+    trainer.init_state(rng)
+    resumed_from = None
+    mgr = None
+    if checkpoint_dir:
+        mgr = CheckpointManager(checkpoint_dir)
+        latest = mgr.latest_step()
+        if latest is not None:
+            template = {"params": trainer.params,
+                        "opt_state": trainer.opt_state}
+            _, state = mgr.restore(latest, template=template)
+            trainer.params = state["params"]
+            trainer.opt_state = state["opt_state"]
+            trainer.step = latest
+            resumed_from = latest
+
+    profiling = False
+    last = {}
+    for batch in batches:
+        if trainer.step >= max_steps:
+            break
+        step = trainer.step
+
+        if profile_dir and not profiling and step == profile_steps[0]:
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        m = trainer.train_step(batch)
+        if profiling and trainer.step >= profile_steps[1]:
+            jax.block_until_ready(m["loss"])
+            jax.profiler.stop_trace()
+            profiling = False
+
+        last = {k: float(v) for k, v in m.items()
+                if hasattr(v, "__float__")}
+        if metrics is not None and trainer.step % metrics_every == 0:
+            metrics.write(trainer.step, **last)
+        if heartbeat is not None:
+            heartbeat.beat(trainer.step)
+        if mgr is not None and trainer.step % checkpoint_every == 0:
+            mgr.save(trainer.step,
+                     {"params": trainer.params,
+                      "opt_state": trainer.opt_state})
+        if on_step is not None:
+            on_step(trainer.step, last)
+
+    if profiling:
+        jax.profiler.stop_trace()
+    if mgr is not None:
+        mgr.save(trainer.step,
+                 {"params": trainer.params, "opt_state": trainer.opt_state},
+                 force=True)
+        mgr.wait()
+        mgr.close()
+    if metrics is not None and last:
+        metrics.write(trainer.step, **last)
+    return FitResult(final_step=trainer.step, resumed_from=resumed_from,
+                     last_metrics=last)

@@ -1,0 +1,185 @@
+"""Platform shell tests: profiles/RBAC/quota, PodDefaults injection into the
+job controller, notebook culling, dashboard aggregation, manifest rendering
+with the zero-GPU guarantee (SURVEY.md §2.6, §3.5)."""
+
+import json
+import urllib.request
+
+import pytest
+import yaml
+
+from kubeflow_tpu.api.types import jax_job
+from kubeflow_tpu.controller.cluster import FakeCluster, PodPhase
+from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.platform import (
+    Dashboard, Notebook, NotebookController, PodDefault, PodDefaultsRegistry,
+    Profile, ProfileController, QuotaExceeded, ResourceQuota, Role,
+    TensorBoard, TensorBoardController, overlay_images, overlay_replicas,
+    render_platform, tpu_worker_pod_template,
+)
+
+
+# ---------------------------------------------------------------- profiles
+
+def test_profile_creates_namespace_and_bindings():
+    ctl = ProfileController()
+    ns = ctl.apply(Profile(name="team-a", owner="alice@example.com"))
+    assert ns.role_bindings["alice@example.com"] == Role.OWNER
+    assert ctl.can("alice@example.com", "team-a", "delete")
+    assert not ctl.can("bob@example.com", "team-a", "get")
+
+
+def test_contributor_management_requires_permission():
+    ctl = ProfileController()
+    ctl.apply(Profile(name="team-a", owner="alice@x.com"))
+    ctl.add_contributor("team-a", "bob@x.com", requester="alice@x.com")
+    assert ctl.can("bob@x.com", "team-a", "create")
+    assert not ctl.can("bob@x.com", "team-a", "manage-access")
+    with pytest.raises(PermissionError):
+        ctl.add_contributor("team-a", "eve@x.com", requester="bob@x.com")
+    ctl.remove_contributor("team-a", "bob@x.com", requester="alice@x.com")
+    assert not ctl.can("bob@x.com", "team-a", "get")
+    assert ctl.namespaces_for("alice@x.com") == ["team-a"]
+
+
+def test_quota_enforcement():
+    ctl = ProfileController()
+    ctl.apply(Profile(name="t", owner="a@x.com",
+                      quota=ResourceQuota(tpu_chips=8, max_jobs=2)))
+    ctl.check_quota("t", tpu_chips=4, new_tpu_chips=4)       # exactly at cap
+    with pytest.raises(QuotaExceeded):
+        ctl.check_quota("t", tpu_chips=4, new_tpu_chips=5)
+    with pytest.raises(QuotaExceeded):
+        ctl.check_quota("t", jobs_running=2, new_jobs=1)
+
+
+# ------------------------------------------------------------- poddefaults
+
+def test_poddefaults_injected_into_job_pods():
+    registry = PodDefaultsRegistry()
+    registry.apply(PodDefault(
+        name="tpu-env", namespace="default",
+        selector={"job-name": "train"},
+        env={"WANDB_MODE": "offline", "KFT_PROFILE": "1"}))
+    cluster = FakeCluster()
+    jobs = JobController(cluster, pod_mutator=registry.mutate)
+    jobs.submit(jax_job("train", workers=2, env={"KFT_PROFILE": "0"}))
+    jobs.reconcile("default", "train")
+    pods = cluster.list_pods("default", {"job-name": "train"})
+    assert len(pods) == 2
+    for pod in pods:
+        assert pod.env["WANDB_MODE"] == "offline"
+        assert pod.env["KFT_PROFILE"] == "0"    # pod's own value wins
+
+    # non-matching job untouched
+    jobs.submit(jax_job("other", workers=1))
+    jobs.reconcile("default", "other")
+    [other] = cluster.list_pods("default", {"job-name": "other"})
+    assert "WANDB_MODE" not in other.env
+
+
+# ---------------------------------------------------------------- notebooks
+
+def test_notebook_lifecycle_and_culling():
+    cluster = FakeCluster()
+    ctl = NotebookController(cluster)
+    ctl.apply(Notebook(name="nb1", cull_idle_seconds=100))
+    assert cluster.get_pod("default", "notebook-nb1") is not None
+    assert cluster.get_service("default", "notebook-nb1") is not None
+
+    nb = ctl.notebooks[("default", "nb1")]
+    nb.last_activity = 0.0
+    culled = ctl.cull_idle(now=500.0)
+    assert culled == ["default/nb1"]
+    assert cluster.get_pod("default", "notebook-nb1") is None
+
+    ctl.touch("default", "nb1")                 # activity restarts it
+    assert cluster.get_pod("default", "notebook-nb1") is not None
+    ctl.delete("default", "nb1")
+    assert cluster.get_pod("default", "notebook-nb1") is None
+
+
+def test_tensorboard_controller():
+    cluster = FakeCluster()
+    ctl = TensorBoardController(cluster)
+    ctl.apply(TensorBoard(name="tb", logdir="/logs/run1"))
+    pod = cluster.get_pod("default", "tensorboard-tb")
+    assert pod.env["TB_LOGDIR"] == "/logs/run1"
+    ctl.delete("default", "tb")
+    assert cluster.get_pod("default", "tensorboard-tb") is None
+
+
+# ---------------------------------------------------------------- dashboard
+
+def test_dashboard_snapshot_scoped_by_profile():
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+    jobs.submit(jax_job("j1", workers=1, namespace="team-a"))
+    jobs.submit(jax_job("j2", workers=1, namespace="team-b"))
+    profiles = ProfileController()
+    profiles.apply(Profile(name="team-a", owner="alice@x.com"))
+    profiles.apply(Profile(name="team-b", owner="bob@x.com"))
+
+    dash = Dashboard(jobs=jobs, profiles=profiles)
+    snap = dash.snapshot(user="alice@x.com")
+    assert [j["name"] for j in snap["jobs"]] == ["j1"]
+    snap_all = dash.snapshot()
+    assert [j["name"] for j in snap_all["jobs"]] == ["j1", "j2"]
+
+
+def test_dashboard_http():
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+    jobs.submit(jax_job("j1", workers=1))
+    dash = Dashboard(jobs=jobs)
+    server = dash.serve()
+    try:
+        host, port = server.server_address[:2]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/api/snapshot") as r:
+            snap = json.loads(r.read())
+        assert snap["jobs"][0]["name"] == "j1"
+        with urllib.request.urlopen(f"http://{host}:{port}/") as r:
+            assert b"kubeflow-tpu dashboard" in r.read()
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------- manifests
+
+def test_render_platform_no_gpu_and_complete():
+    text = render_platform()
+    docs = list(yaml.safe_load_all(text))
+    kinds = {}
+    for d in docs:
+        kinds.setdefault(d["kind"], []).append(d["metadata"]["name"])
+    assert "nvidia" not in text.lower()
+    assert len(kinds["CustomResourceDefinition"]) >= 15
+    assert any("training-controller" == n for n in kinds["Deployment"])
+    assert any("metadata-store" == n for n in kinds["Deployment"])
+    # every deployment has rbac
+    for dep in kinds["Deployment"]:
+        assert dep in kinds["ServiceAccount"]
+
+
+def test_manifest_overlays():
+    text = render_platform(overlays=[
+        overlay_images({"kubeflow-tpu/controller:latest": "reg.io/ctl:v2"}),
+        overlay_replicas("dashboard", 3),
+    ])
+    docs = list(yaml.safe_load_all(text))
+    deps = {d["metadata"]["name"]: d for d in docs
+            if d["kind"] == "Deployment"}
+    img = deps["training-controller"]["spec"]["template"]["spec"][
+        "containers"][0]["image"]
+    assert img == "reg.io/ctl:v2"
+    assert deps["dashboard"]["spec"]["replicas"] == 3
+
+
+def test_tpu_pod_template_contract():
+    tmpl = tpu_worker_pod_template("v5p", "4x4x4")
+    sel = tmpl["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x4x4"
+    limits = tmpl["containers"][0]["resources"]["limits"]
+    assert "google.com/tpu" in limits and "nvidia.com/gpu" not in limits
