@@ -60,15 +60,19 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
-    def flops_per_token(self) -> float:
+    def flops_per_token(self, seq: int | None = None) -> float:
         """Approx model FLOPs per token (fwd+bwd = 3x fwd matmul FLOPs).
+        With ``seq`` the causal attention-score FLOPs (QK^T and PV, avg
+        context seq/2) are included — the MFU-honest accounting. Remat
+        recompute is deliberately NOT counted (it lowers reported MFU).
         For MoE only the top-k experts' FFN FLOPs are active per token."""
         d, m, v = self.dim, self.mlp_dim, self.vocab_size
         attn_proj = 2 * d * (self.n_heads + 2 * self.n_kv_heads) * self.head_dim
         attn_out = 2 * self.n_heads * self.head_dim * d
+        attn_score = (2 * seq * self.n_heads * self.head_dim) if seq else 0
         active_ffns = self.moe_top_k if self.n_experts else 1
         mlp = 2 * 3 * d * m * active_ffns
-        per_layer = attn_proj + attn_out + mlp
+        per_layer = attn_proj + attn_out + attn_score + mlp
         return 3 * (self.n_layers * per_layer + 2 * d * v)
 
     def moe_config(self):

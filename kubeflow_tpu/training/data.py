@@ -31,21 +31,27 @@ def put_batch(mesh: Mesh, batch):
 
 
 def synthetic_lm_batches(
-    vocab_size: int, global_batch: int, seq_len: int, seed: int = 0
+    vocab_size: int, global_batch: int, seq_len: int, seed: int = 0,
+    start_step: int = 0,
 ) -> Iterator[dict]:
     """Infinite synthetic token batches: {"tokens": [B, S+1]} on host.
 
-    Multi-host aware: yields only this process's slice of the global batch.
+    Step-indexed: batch ``i`` is a pure function of ``(seed, i, process)``,
+    so a resumed job can seek with ``start_step`` and see the exact same
+    step->batch mapping (the deterministic data-resume contract of
+    ``loop.fit``). Multi-host aware: yields only this process's slice.
     """
-    rng = np.random.default_rng(seed + jax.process_index())
     n_proc = jax.process_count()
     local = global_batch // n_proc
+    step = start_step
     while True:
+        rng = np.random.default_rng([seed, step, jax.process_index()])
         yield {
             "tokens": rng.integers(
                 0, vocab_size, (local, seq_len + 1), dtype=np.int32
             )
         }
+        step += 1
 
 
 def mnist_synthetic(batch: int, seed: int = 0) -> Iterator[dict]:

@@ -10,6 +10,7 @@ where it left off with no operator involvement beyond re-running the pod.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import time
 from typing import Any, Callable, Iterable, Optional
@@ -46,7 +47,7 @@ class Heartbeat:
 
 def fit(
     trainer: Trainer,
-    batches: Iterable[Any],
+    batches: Iterable[Any] | Callable[[int], Iterable[Any]],
     *,
     rng: jax.Array,
     max_steps: int,
@@ -62,9 +63,11 @@ def fit(
     """Run training with auto-resume.
 
     If ``checkpoint_dir`` holds a checkpoint, state is restored and training
-    continues from the saved step; otherwise state is initialized from
-    ``rng``. Batches are consumed from the iterator either way (callers
-    should seed/skip data deterministically if exact data order matters).
+    continues from the saved step. Data is resumed deterministically:
+    ``batches`` may be a callable ``(start_step) -> iterator`` (preferred —
+    a step-indexed dataset can seek directly), or a plain iterable, in which
+    case the first ``resumed_from`` batches are consumed and discarded so a
+    restarted job sees the same step->batch mapping as an uninterrupted one.
     """
     trainer.init_state(rng)
     resumed_from = None
@@ -76,10 +79,23 @@ def fit(
             template = {"params": trainer.params,
                         "opt_state": trainer.opt_state}
             _, state = mgr.restore(latest, template=template)
+            # re-place on the template's shardings: orbax can hand back
+            # scalar/replicated leaves on a single device, which would then
+            # clash with the mesh-placed params inside the jitted step
+            state = jax.tree_util.tree_map(
+                lambda x, t: jax.device_put(x, t.sharding)
+                if hasattr(t, "sharding") else x,
+                state, template,
+            )
             trainer.params = state["params"]
             trainer.opt_state = state["opt_state"]
             trainer.step = latest
             resumed_from = latest
+
+    if callable(batches):
+        batches = batches(trainer.step)
+    elif resumed_from:
+        batches = itertools.islice(iter(batches), resumed_from, None)
 
     profiling = False
     last = {}
@@ -93,7 +109,10 @@ def fit(
             profiling = True
         m = trainer.train_step(batch)
         if profiling and trainer.step >= profile_steps[1]:
-            jax.block_until_ready(m["loss"])
+            # device_get, not block_until_ready: the latter is a no-op on
+            # the remote-tunnel TPU platform and would close the trace
+            # before the profiled steps actually execute
+            float(jax.device_get(m["loss"]))
             jax.profiler.stop_trace()
             profiling = False
 
@@ -113,9 +132,14 @@ def fit(
     if profiling:
         jax.profiler.stop_trace()
     if mgr is not None:
-        mgr.save(trainer.step,
-                 {"params": trainer.params, "opt_state": trainer.opt_state},
-                 force=True)
+        # final save — unless this exact step is already on disk (the
+        # in-loop save fired on it, or a resumed run trained 0 steps);
+        # force= bypasses the save-interval policy, not step collisions.
+        if mgr.latest_step() != trainer.step:
+            mgr.save(trainer.step,
+                     {"params": trainer.params,
+                      "opt_state": trainer.opt_state},
+                     force=True)
         mgr.wait()
         mgr.close()
     if metrics is not None and last:

@@ -33,6 +33,10 @@ class TrainerConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     grad_accum: int = 1
+    # "adamw": full f32 m/v (2x params of state). "adafactor": factored
+    # second moment (state ~ O(rows+cols)) — the memory-budget choice for
+    # big models on small HBM (T5X-style default on TPU).
+    optimizer: str = "adamw"
     rules: Mapping[str, object] | None = None   # logical->mesh rules override
 
 
@@ -41,6 +45,17 @@ def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
         0.0, cfg.learning_rate, cfg.warmup_steps, max(cfg.total_steps, cfg.warmup_steps + 1),
         end_value=cfg.learning_rate * 0.1,
     )
+    if cfg.optimizer == "adafactor":
+        # no weight decay here: optax.adafactor applies weight_decay_rate
+        # AFTER lr scaling (raw fraction per step — 0.1 would collapse the
+        # params), unlike adamw's lr-scaled decay. Adafactor runs train
+        # decay-free (the T5X-style default).
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adafactor(schedule),
+        )
+    if cfg.optimizer != "adamw":
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
         optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
@@ -78,15 +93,47 @@ class Trainer:
             mesh, PartitionSpec(("data", "fsdp"))
         )
 
-        # init params directly into their shards (no host-side full copy);
-        # optimizer state inherits param shardings through propagation.
+        # init params directly into their shards (no host-side full copy)
         self._init_jit = jax.jit(init_params_fn, out_shardings=self.param_shardings)
-        self._opt_init = jax.jit(self.optimizer.init)
+        # optimizer.init only reads shapes, so jit does NOT propagate input
+        # shardings to its outputs — compute explicit out_shardings: any opt
+        # leaf that mirrors a param (adam mu/nu trees) inherits that param's
+        # sharding, everything else (counts, empty states) is replicated.
+        params_shape = jax.eval_shape(init_params_fn, jax.random.key(0))
+        self.opt_shardings = self._opt_state_shardings(params_shape)
+        self._opt_init = jax.jit(
+            self.optimizer.init, out_shardings=self.opt_shardings
+        )
 
         self.step_fn = self._build_step(donate_state)
         self.params = None
         self.opt_state = None
         self.step = 0
+
+    def _opt_state_shardings(self, params_shape):
+        """Shardings for the optimizer state, matched by path suffix: optax
+        wraps the params treedef inside its own states (mu/nu/...), so a
+        param's path is a suffix of its mirror's path in the opt state."""
+        opt_shapes = jax.eval_shape(self.optimizer.init, params_shape)
+        is_sh = lambda x: isinstance(x, NamedSharding)
+        p_sh = jax.tree_util.tree_flatten_with_path(
+            self.param_shardings, is_leaf=is_sh)[0]
+        p_shape = jax.tree_util.tree_flatten_with_path(params_shape)[0]
+        by_path = {
+            tuple(map(str, path)): (shape.shape, sh)
+            for (path, sh), (_, shape) in zip(p_sh, p_shape)
+        }
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+
+        def pick(path, leaf):
+            p = tuple(map(str, path))
+            for i in range(len(p)):
+                hit = by_path.get(p[i:])
+                if hit is not None and hit[0] == leaf.shape:
+                    return hit[1]
+            return replicated
+
+        return jax.tree_util.tree_map_with_path(pick, opt_shapes)
 
     def init_state(self, rng: jax.Array):
         self.params = self._init_jit(rng)
@@ -113,20 +160,31 @@ class Trainer:
                     lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
                     batch,
                 )
+                mb0 = jax.tree_util.tree_map(lambda x: x[0], micro)
+                _, m_shapes, _ = jax.eval_shape(grads_of, params, mb0)
 
                 def body(carry, mb):
-                    g_acc, loss_acc = carry
-                    loss, _, grads = grads_of(params, mb)
+                    g_acc, loss_acc, m_acc = carry
+                    loss, metrics, grads = grads_of(params, mb)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
-                    return (g_acc, loss_acc + loss), None
+                    m_acc = jax.tree_util.tree_map(jnp.add, m_acc, metrics)
+                    return (g_acc, loss_acc + loss, m_acc), None
 
-                zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                zeros_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+                zeros_m = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), m_shapes
                 )
-                (grads, loss), _ = jax.lax.scan(body, (zeros, 0.0), micro)
+                (grads, loss, m_sum), _ = jax.lax.scan(
+                    body, (zeros_g, 0.0, zeros_m), micro
+                )
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 loss = loss / accum
-                metrics = {}
+                # counts ("tokens") sum across microbatches; everything else
+                # (aux losses etc.) is averaged like the loss
+                metrics = {
+                    k: (v if k == "tokens" else v / accum)
+                    for k, v in m_sum.items()
+                }
             else:
                 loss, metrics, grads = grads_of(params, batch)
 
