@@ -47,7 +47,9 @@ def main():
         # 16G-HBM budget (v5e): flash attention (no SxS logits), adafactor
         # (factored 2nd moment — no 6.6G of adam m/v), grad-accum halves the
         # [micro, S, V] f32 logit peak. Params/grads stay f32 (~6.6G).
-        cfg = llama.llama_1b(remat="full", attn_impl="flash")
+        # "pallas" = the first-party GQA-native kernel (ops/pallas_attention)
+        # — ~1.9x faster fwd+bwd than the stock kernel (no KV-head repeat).
+        cfg = llama.llama_1b(remat="full", attn_impl="pallas")
         global_batch, seq = 32, 2048
         steps, warmup = 10, 2
         accum, opt = 8, "adafactor"

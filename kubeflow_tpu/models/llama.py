@@ -334,7 +334,12 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
         v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        o = attention(q, k, v, causal=True, impl="xla")
+        # honor the configured impl ("ring"/"ulysses" are training-only
+        # context-parallel paths; prefill falls back to the first-party
+        # pallas kernel for those — O(S) memory, CPU-interpretable)
+        impl = cfg.attn_impl if cfg.attn_impl in ("xla", "flash", "pallas") \
+            else "pallas"
+        o = attention(q, k, v, causal=True, impl=impl)
         o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -61,12 +61,25 @@ def attention(
     q: [B, Sq, H, D]; k, v: [B, Skv, KV_H, D] with H % KV_H == 0.
     `q_offset` shifts query positions for causal masking during decode.
     """
+    platform = jax.default_backend()
+    if impl in ("flash", "pallas") and not (
+            isinstance(q_offset, int) and q_offset == 0):
+        raise ValueError(
+            f"impl={impl!r} does not support q_offset; use impl='xla' "
+            "(decode paths use decode_attention)")
     if impl == "flash":
+        if platform != "tpu":
+            # the stock kernel has no interpreter path; xla is the
+            # numerics-identical CPU/GPU stand-in
+            return _xla_attention(q, k, v, causal=causal)
         return _flash_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
     if impl == "pallas":
+        if platform not in ("tpu", "cpu"):
+            return _xla_attention(q, k, v, causal=causal)
         from kubeflow_tpu.ops.pallas_attention import flash_attention as own_flash
 
-        return own_flash(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+        return own_flash(q, k, v, causal=causal, block_q=block_q,
+                         block_kv=block_kv, interpret=platform == "cpu")
     return _xla_attention(q, k, v, causal=causal, q_offset=q_offset)
 
 

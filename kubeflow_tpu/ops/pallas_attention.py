@@ -1,0 +1,317 @@
+"""First-party Pallas TPU flash attention (forward + backward).
+
+Design (TPU-first, not a port — the reference ships no kernels at all; its
+GPU analogue would be a CUDA flash kernel inside user containers):
+
+- **Online softmax** over KV blocks: O(S) memory, no [S, S] logits —
+  the long-context path SURVEY.md §5 requires.
+- **GQA-native**: the grid iterates query heads; K/V blocks are indexed by
+  ``kv_head = head // group`` directly in the BlockSpec index map, so
+  grouped KV heads are never materialized ``repeat``-ed (the stock
+  ``jax.experimental.pallas.ops.tpu.flash_attention`` needs H == KV_H and
+  forces an O(S·H·D) repeat for GQA).
+- **Flash backward**: saves only the per-row logsumexp; recomputes P
+  blockwise in two kernels (dq; dk/dv fused per KV block, summing over the
+  query-head group).
+- f32 softmax/accumulation regardless of input dtype (MXU takes bf16 in,
+  f32 out via ``preferred_element_type``).
+
+Layout contract: q [B, S, H, D]; k/v [B, S, KV_H, D] — transposed to
+[B, H, S, D] internally so each (head, seq-block) tile is contiguous.
+Sequence lengths must divide the block sizes (the wrapper clamps blocks to
+the sequence length); D should be a multiple of 128 for MXU tiling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _mask(i, j, block_q, block_kv, causal, kv_len):
+    """Validity mask for an (i, j) tile: KV padding rows are always masked;
+    the causal triangle additionally when ``causal``. ``kv_len`` is the real
+    (pre-padding) KV length — a static compile-time constant."""
+    kv_pos = j * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    m = kv_pos < kv_len
+    if causal:
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        m = jnp.logical_and(m, q_pos >= kv_pos)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale, block_q, block_kv, causal, seq_kv, kv_len):
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, D]
+    d = q.shape[-1]
+
+    n_kv = seq_kv // block_kv
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        n_kv = jnp.minimum(
+            n_kv, jax.lax.div((i + 1) * block_q + block_kv - 1, block_kv))
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # [bq, bkv]
+        s = jnp.where(_mask(i, j, block_q, block_kv, causal, kv_len),
+                      s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # lse stored 8-sublane-replicated ([..., 8, block_q]) so the block shape
+    # meets the TPU (8, 128) tile-alignment rule for outputs
+    lse_ref[0, 0] = jnp.broadcast_to(
+        (m + jnp.log(l))[None, :], (8, block_q))
+
+
+def _fwd(q, k, v, causal, block_q, block_kv, kv_len, interpret):
+    """q/k/v in [B, H|KVH, S, D] (padded to block multiples).
+    Returns (o [B,H,S,D], lse [B,H,8,S])."""
+    b, h, sq, d = q.shape
+    _, kvh, skv, _ = k.shape
+    g = h // kvh
+    scale = 1.0 / (d ** 0.5)
+    grid = (b, h, sq // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
+        causal=causal, seq_kv=skv, kv_len=kv_len,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bi, hi, i: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bi, hi, i: (bi, hi // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda bi, hi, i: (bi, hi, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 8, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Backward: dq kernel (grid over query blocks), dk/dv kernel (KV blocks)
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, block_q, block_kv, causal, seq_kv, kv_len):
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                  # [bq, D]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, 0, :]                            # sublane 0 of [8, bq]
+    delta = delta_ref[0, 0, 0, :]
+    d = q.shape[-1]
+
+    n_kv = seq_kv // block_kv
+    if causal:
+        n_kv = jnp.minimum(
+            n_kv, jax.lax.div((i + 1) * block_q + block_kv - 1, block_kv))
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(_mask(i, j, block_q, block_kv, causal, kv_len),
+                      s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                     # [bq, bkv]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, n_kv, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, block_q, block_kv, causal,
+                seq_q, groups, kv_len):
+    j = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)                  # [bkv, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    d = k.shape[-1]
+
+    n_q = seq_q // block_q
+    lo = jax.lax.div(j * block_kv, block_q) if causal else 0
+
+    dk = jnp.zeros((block_kv, d), jnp.float32)
+    dv = jnp.zeros((block_kv, d), jnp.float32)
+    for g in range(groups):                               # static unroll
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[0, g, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            do = do_ref[0, g, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            lse = lse_ref[0, g, 0, pl.ds(i * block_q, block_q)]
+            delta = delta_ref[0, g, 0, pl.ds(i * block_q, block_q)]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(_mask(i, j, block_q, block_kv, causal, kv_len),
+                          s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])                 # [bq, bkv]
+            dv = dv + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None]) * scale
+            dk = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk, dv))
+
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_kv, kv_len, interpret, res, do):
+    q, k, v, o, lse = res                                # lse: [B, H, S] f32
+    b, h, sq, d = q.shape
+    _, kvh, skv, _ = k.shape
+    g = h // kvh
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # 8-sublane replication only at the kernel boundary (tile alignment);
+    # residuals above stay [B, H, S]
+    delta8 = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, sq))
+    lse8 = jnp.broadcast_to(lse[:, :, None, :], (b, h, 8, sq))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
+            causal=causal, seq_kv=skv, kv_len=kv_len),
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bi, hi, i: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bi, hi, i: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda bi, hi, i: (bi, hi, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda bi, hi, i: (bi, hi, 0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse8, delta8)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
+            causal=causal, seq_q=sq, groups=g, kv_len=kv_len),
+        grid=(b, kvh, skv // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, g, sq, d), lambda bi, hi, j: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
+            pl.BlockSpec((1, g, sq, d), lambda bi, hi, j: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, g, 8, sq), lambda bi, hi, j: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, g, 8, sq), lambda bi, hi, j: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kvh, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, kvh, skv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse8, delta8)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_kv, kv_len, interpret):
+    o, _ = _fwd(q, k, v, causal, block_q, block_kv, kv_len, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv, kv_len, interpret):
+    o, lse8 = _fwd(q, k, v, causal, block_q, block_kv, kv_len, interpret)
+    return o, (q, k, v, o, lse8[:, :, 0, :])   # residual lse is [B, H, S]
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def _pad_seq(x, block):
+    s = x.shape[2]
+    pad = (-s) % block
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
+                    block_kv: int = 512, interpret: bool = False):
+    """Flash attention. q: [B, S, H, D]; k/v: [B, S, KV_H, D] -> [B, S, H, D].
+
+    GQA handled natively (H % KV_H == 0); KV heads are never repeated.
+    Arbitrary sequence lengths: inputs are zero-padded to block multiples
+    and padded KV positions are masked inside the kernels (padding/slicing
+    sits outside the custom_vjp, so gradients transpose correctly).
+    ``interpret=True`` runs the Pallas interpreter (CPU tests).
+    """
+    b, sq, h, d = q.shape
+    _, skv, kvh, _ = k.shape
+    if h % kvh:
+        raise ValueError(f"H={h} not a multiple of KV_H={kvh}")
+    block_q = min(block_q, max(sq, 8))
+    block_kv = min(block_kv, max(skv, 8))
+    qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)    # [B, H, S', D]
+    kt = _pad_seq(k.transpose(0, 2, 1, 3), block_kv)
+    vt = _pad_seq(v.transpose(0, 2, 1, 3), block_kv)
+    o = _flash(qt, kt, vt, causal, block_q, block_kv, skv, interpret)
+    return o[:, :, :sq, :].transpose(0, 2, 1, 3)

@@ -1,0 +1,120 @@
+"""First-party Pallas flash kernel vs the XLA einsum reference.
+
+Runs the kernel in interpret mode on CPU (SURVEY.md §4: accelerator logic
+must be testable without accelerators); the same code path compiles for TPU
+(benchmarked in bench variants / ops.attention impl="pallas")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.attention import _xla_attention
+from kubeflow_tpu.ops.pallas_attention import flash_attention
+
+
+def _rand_qkv(key, b, s, h, kvh, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, kvh, d), dtype)
+    v = jax.random.normal(kv, (b, s, kvh, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("h,kvh", [(4, 4), (4, 2), (8, 2)])
+def test_forward_matches_xla(causal, h, kvh):
+    q, k, v = _rand_qkv(jax.random.key(0), 2, 64, h, kvh, 32)
+    ref = _xla_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_kv=16,
+                          interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_blocks():
+    """block_q != block_kv and blocks that don't tile the diagonal evenly."""
+    q, k, v = _rand_qkv(jax.random.key(1), 1, 64, 2, 2, 32)
+    ref = _xla_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_kv=16,
+                          interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_kv=32,
+                          interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("h,kvh", [(4, 4), (4, 2)])
+def test_grads_match_xla(h, kvh):
+    q, k, v = _rand_qkv(jax.random.key(2), 2, 32, h, kvh, 32)
+    w = jax.random.normal(jax.random.key(3), q.shape)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True) * w)
+
+    def loss_pl(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=16, block_kv=16,
+            interpret=True) * w)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ref, g_pl, "qkv"):
+        np.testing.assert_allclose(
+            b, a, rtol=5e-5, atol=5e-5,
+            err_msg=f"grad mismatch for {name}")
+
+
+def test_bf16_inputs():
+    q, k, v = _rand_qkv(jax.random.key(4), 1, 32, 4, 2, 32, jnp.bfloat16)
+    ref = _xla_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_kv=16,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("s", [48, 33, 100])
+@pytest.mark.parametrize("causal", [True, False])
+def test_unaligned_seq_lengths(s, causal):
+    """Sequences that don't divide the blocks are zero-padded and the pad
+    masked — output and grads must still match the reference exactly."""
+    q, k, v = _rand_qkv(jax.random.key(7), 1, s, 4, 2, 32)
+    ref = _xla_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_kv=32,
+                          interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    w = jax.random.normal(jax.random.key(8), q.shape)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, causal=causal) * w), argnums=(0, 1, 2))(q, k, v)
+    g_pl = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=causal, block_q=32, block_kv=32,
+        interpret=True) * w), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_pl):
+        np.testing.assert_allclose(b, a, rtol=5e-5, atol=5e-5)
+
+
+def test_rejects_bad_shapes():
+    q2, k2, v2 = _rand_qkv(jax.random.key(5), 1, 32, 4, 3, 32)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q2, k2, v2, block_q=16, block_kv=16, interpret=True)
+
+
+def test_q_offset_rejected_for_kernel_impls():
+    from kubeflow_tpu.ops.attention import attention
+
+    q, k, v = _rand_qkv(jax.random.key(9), 1, 32, 4, 2, 32)
+    with pytest.raises(ValueError, match="q_offset"):
+        attention(q, k, v, causal=True, impl="pallas", q_offset=4)
+
+
+def test_attention_dispatcher_pallas_impl():
+    from kubeflow_tpu.ops.attention import attention
+
+    q, k, v = _rand_qkv(jax.random.key(6), 1, 64, 4, 2, 32)
+    ref = attention(q, k, v, causal=True, impl="xla")
+    out = attention(q, k, v, causal=True, impl="pallas",
+                    block_q=16, block_kv=16)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
