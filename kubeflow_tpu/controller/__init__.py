@@ -2,4 +2,5 @@ from kubeflow_tpu.controller.cluster import (
     Cluster, FakeCluster, LocalProcessCluster, Pod, PodPhase, Service,
 )
 from kubeflow_tpu.controller.gang import GangScheduler, PodGroup, SlicePool
+from kubeflow_tpu.controller.operator import Metrics, Operator
 from kubeflow_tpu.controller.reconciler import JobController, pod_name

@@ -1,0 +1,57 @@
+"""Operator CLI: ``python -m kubeflow_tpu.controller serve``.
+
+The deployable long-running controller process (SURVEY.md §2.1 operator
+entrypoint). Flags follow the reference's binary-flag tier (SURVEY.md §5
+config system); everything else comes from the job specs themselves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeflow_tpu.controller")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    serve = sub.add_parser("serve", help="run the operator daemon")
+    serve.add_argument("--port", type=int, default=8080,
+                       help="HTTP port for API + /metrics (0 = ephemeral)")
+    serve.add_argument("--cluster", choices=("local", "fake"), default="local",
+                       help="pod backend: local subprocesses or in-memory")
+    serve.add_argument("--heartbeat-dir", default="/tmp/kft-heartbeats")
+    serve.add_argument("--heartbeat-timeout", type=float, default=60.0)
+    serve.add_argument("--reconcile-period", type=float, default=0.25)
+    serve.add_argument("--log-dir", default="/tmp/kft-pods")
+    args = parser.parse_args(argv)
+
+    from kubeflow_tpu.controller.cluster import FakeCluster, LocalProcessCluster
+    from kubeflow_tpu.controller.operator import Operator
+    from kubeflow_tpu.controller.reconciler import JobController
+
+    cluster = (LocalProcessCluster(log_dir=args.log_dir)
+               if args.cluster == "local" else FakeCluster())
+    controller = JobController(cluster)
+    op = Operator(
+        controller,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        reconcile_period=args.reconcile_period,
+    )
+    port = op.start(port=args.port)
+    print(f"kft-operator serving on 127.0.0.1:{port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    op.stop()
+    if isinstance(cluster, LocalProcessCluster):
+        cluster.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,316 @@
+"""The operator process — the reference's defining artifact, TPU-native.
+
+Parity: SURVEY.md §2.1 'Operator entrypoint' ([U] training-operator:
+cmd/training-operator.v1/main.go) — a long-running daemon that (a)
+continuously reconciles every registered job, (b) sweeps worker heartbeats
+(fault signaling, §2.8), (c) ticks serving reconcilers/autoscalers, and
+(d) serves /healthz + /metrics plus a small REST API surface (the
+kube-apiserver role in this single-binary architecture: job submission is
+an HTTP POST of the JobSpec YAML/JSON).
+
+North-star #2 (BASELINE.md "job-submit -> first-training-step latency") is
+measured here: the operator injects KFT_HEARTBEAT_FILE into every pod; the
+training loop auto-beats it each step (content = step number), and the
+heartbeat sweep records the delta between submit time and the first beat
+with step >= 1 as ``kft_submit_to_first_step_seconds``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubeflow_tpu.api.types import from_yaml, to_yaml
+from kubeflow_tpu.controller.heartbeat import FileHeartbeatTracker, check_heartbeats
+from kubeflow_tpu.controller.reconciler import JobController
+
+
+class Metrics:
+    """Minimal Prometheus-style registry (counters + gauges, text format)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[dict] = None) -> str:
+        if not labels:
+            return name
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}}"
+
+    def inc(self, name: str, labels: Optional[dict] = None, by: float = 1.0):
+        key = self._key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + by
+
+    def set(self, name: str, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def get(self, name: str, labels: Optional[dict] = None) -> Optional[float]:
+        key = self._key(name, labels)
+        with self._lock:
+            return self._counters.get(key, self._gauges.get(key))
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [f"{k} {v}" for k, v in sorted(self._counters.items())]
+            lines += [f"{k} {v}" for k, v in sorted(self._gauges.items())]
+        return "\n".join(lines) + "\n"
+
+
+class Operator:
+    """Reconcile loops + heartbeat sweep + serving ticks, as daemon threads.
+
+    ``serving_tickers`` is a list of zero-arg callables (e.g. a closure over
+    ServingController.reconcile or Autoscaler.tick) invoked every
+    ``serving_period`` — the knative/HPA control-loop role."""
+
+    def __init__(
+        self,
+        controller: JobController,
+        heartbeat_dir: Optional[str] = None,
+        heartbeat_timeout_s: float = 60.0,
+        startup_grace_s: float = 300.0,
+        reconcile_period: float = 0.25,
+        heartbeat_period: float = 1.0,
+        serving_tickers: tuple = (),
+        serving_period: float = 1.0,
+    ):
+        self.controller = controller
+        self.metrics = Metrics()
+        self.heartbeat_dir = heartbeat_dir
+        self.tracker = (
+            FileHeartbeatTracker(heartbeat_dir, timeout_s=heartbeat_timeout_s,
+                                 startup_grace_s=startup_grace_s)
+            if heartbeat_dir else None
+        )
+        self.reconcile_period = reconcile_period
+        self.heartbeat_period = heartbeat_period
+        self.serving_tickers = tuple(serving_tickers)
+        self.serving_period = serving_period
+        self._submit_times: dict[tuple[str, str], float] = {}
+        self._first_step_seen: set[tuple[str, str]] = set()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+
+        if self.tracker is not None:
+            # chain onto any existing mutator: every pod gets its heartbeat
+            # file path so the training loop can auto-beat it
+            prev = controller.pod_mutator
+
+            def mutator(pod):
+                if prev is not None:
+                    pod = prev(pod)
+                job = pod.labels.get("job-name", "")
+                pod.env.setdefault(
+                    "KFT_HEARTBEAT_FILE", self.tracker.path_for(job, pod.name))
+                return pod
+
+            controller.pod_mutator = mutator
+
+    # ---------------- job API (the apiserver role) ----------------
+
+    def submit(self, job) -> None:
+        self.controller.submit(job)
+        self._submit_times[(job.namespace, job.name)] = time.time()
+        self.metrics.inc("kft_jobs_submitted_total")
+
+    # ---------------- loops ----------------
+
+    def _reconcile_loop(self):
+        while not self._stop.wait(self.reconcile_period):
+            keys = list(self.controller.jobs.keys())
+            self.metrics.set("kft_jobs_registered", len(keys))
+            pending = 0
+            phases: dict[str, int] = {}
+            for ns, name in keys:
+                t0 = time.perf_counter()
+                try:
+                    job = self.controller.reconcile(ns, name)
+                except Exception:
+                    self.metrics.inc("kft_reconcile_errors_total")
+                    continue
+                dt = time.perf_counter() - t0
+                self.metrics.inc("kft_reconcile_total")
+                self.metrics.inc("kft_reconcile_seconds_sum", by=dt)
+                if job is None:
+                    continue
+                cond = job.status.condition()
+                phases[cond.value if cond else "Unknown"] = (
+                    phases.get(cond.value if cond else "Unknown", 0) + 1)
+                if cond is not None and cond.value == "Created":
+                    pending += 1
+            for phase, n in phases.items():
+                self.metrics.set("kft_jobs", n, {"phase": phase})
+            self.metrics.set(
+                "kft_gang_queue_depth",
+                sum(1 for g in getattr(self.controller.scheduler, "groups", {})
+                    if not self.controller.scheduler.is_admitted(*g))
+                if hasattr(self.controller.scheduler, "groups") else pending,
+            )
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_period):
+            for (ns, name) in list(self.controller.jobs.keys()):
+                stale = check_heartbeats(self.controller, ns, name, self.tracker)
+                if stale:
+                    self.metrics.inc("kft_heartbeat_stale_total", by=len(stale))
+                self._record_first_step(ns, name)
+
+    def _record_first_step(self, ns: str, name: str):
+        key = (ns, name)
+        if key in self._first_step_seen or key not in self._submit_times:
+            return
+        job = self.controller.get(ns, name)
+        if job is None:
+            return
+        for pod in self.controller.cluster.list_pods(
+                ns, {"job-name": name, "job-uid": job.uid}):
+            if pod is None:
+                continue
+            path = self.tracker.path_for(name, pod.name)
+            try:
+                with open(path) as f:
+                    step = int(f.read().strip() or 0)
+                mtime = os.path.getmtime(path)
+            except (OSError, ValueError):
+                continue
+            if step >= 1:
+                self._first_step_seen.add(key)
+                self.metrics.set(
+                    "kft_submit_to_first_step_seconds",
+                    mtime - self._submit_times[key],
+                    {"namespace": ns, "job": name},
+                )
+                return
+
+    def _serving_loop(self):
+        while not self._stop.wait(self.serving_period):
+            for tick in self.serving_tickers:
+                try:
+                    tick()
+                    self.metrics.inc("kft_serving_ticks_total")
+                except Exception:
+                    self.metrics.inc("kft_serving_tick_errors_total")
+
+    # ---------------- lifecycle ----------------
+
+    def start(self, port: int = 0) -> int:
+        """Start loops + HTTP server; returns the bound port."""
+        self._threads = [
+            threading.Thread(target=self._reconcile_loop, daemon=True,
+                             name="kft-reconcile"),
+        ]
+        if self.tracker is not None:
+            self._threads.append(threading.Thread(
+                target=self._heartbeat_loop, daemon=True, name="kft-heartbeat"))
+        if self.serving_tickers:
+            self._threads.append(threading.Thread(
+                target=self._serving_loop, daemon=True, name="kft-serving"))
+        for t in self._threads:
+            t.start()
+        self._httpd = _make_http_server(self, port)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="kft-http").start()
+        return self.port
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def _job_to_dict(job) -> dict:
+    cond = job.status.condition()
+    return {
+        "namespace": job.namespace,
+        "name": job.name,
+        "kind": job.kind,
+        "uid": job.uid,
+        "condition": cond.value if cond else None,
+        "restart_count": job.status.restart_count,
+        "conditions": [
+            {"type": c.type.value, "reason": c.reason, "message": c.message}
+            for c in job.status.conditions
+        ],
+        "replica_statuses": {
+            rt: {"active": rs.active, "succeeded": rs.succeeded,
+                 "failed": rs.failed}
+            for rt, rs in job.status.replica_statuses.items()
+        },
+    }
+
+
+def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: str,
+                  ctype: str = "application/json"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _job_path(self):
+            # /apis/v1/namespaces/{ns}/jobs[/{name}]
+            parts = self.path.strip("/").split("/")
+            if (len(parts) >= 4 and parts[0] == "apis" and parts[1] == "v1"
+                    and parts[2] == "namespaces" and parts[4:5] == ["jobs"]):
+                return parts[3], (parts[5] if len(parts) > 5 else None)
+            return None, None
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._send(200, "ok", "text/plain")
+            if self.path == "/metrics":
+                return self._send(200, op.metrics.render(), "text/plain")
+            ns, name = self._job_path()
+            if ns and name:
+                job = op.controller.get(ns, name)
+                if job is None:
+                    return self._send(404, '{"error": "not found"}')
+                return self._send(200, json.dumps(_job_to_dict(job)))
+            if ns:
+                jobs = [_job_to_dict(j) for (jns, _), j in
+                        op.controller.jobs.items() if jns == ns]
+                return self._send(200, json.dumps({"items": jobs}))
+            self._send(404, '{"error": "unknown path"}')
+
+        def do_POST(self):
+            ns, _ = self._job_path()
+            if not ns:
+                return self._send(404, '{"error": "unknown path"}')
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length).decode()
+            try:
+                job = from_yaml(body)   # YAML superset: JSON bodies work too
+                job.namespace = job.namespace or ns
+                op.submit(job)
+            except Exception as e:
+                return self._send(400, json.dumps({"error": str(e)}))
+            self._send(201, json.dumps(_job_to_dict(job)))
+
+        def do_DELETE(self):
+            ns, name = self._job_path()
+            if not (ns and name):
+                return self._send(404, '{"error": "unknown path"}')
+            op.controller.delete(ns, name)
+            self._send(200, "{}")
+
+    return ThreadingHTTPServer(("127.0.0.1", port), Handler)

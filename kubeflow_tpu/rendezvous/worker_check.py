@@ -47,6 +47,43 @@ def main() -> int:
         MetricsWriter(metrics_path).write(
             0, world_ok=1.0, process_id=world.process_id, total=total
         )
+
+    # optional real-training mode: KFT_TRAIN_STEPS makes this the
+    # 'tiny CPU training image' of the operator e2e — an actual fit() on the
+    # world mesh, so heartbeats/first-step latency come from real steps
+    steps = int(os.environ.get("KFT_TRAIN_STEPS", "0"))
+    if steps:
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama
+        from kubeflow_tpu.training import (
+            Trainer, TrainerConfig, lm_loss_fn, put_batch,
+            synthetic_lm_batches,
+        )
+        from kubeflow_tpu.training.loop import fit
+
+        cfg = llama.llama_tiny(dtype=jnp.float32)
+        trainer = Trainer(
+            mesh=mesh,
+            init_params_fn=lambda r: llama.init_params(r, cfg),
+            params_logical_axes=llama.param_logical_axes(cfg),
+            loss_fn=lm_loss_fn(llama.forward, cfg),
+            config=TrainerConfig(learning_rate=1e-3, warmup_steps=2,
+                                 total_steps=max(steps, 3)),
+        )
+        global_batch = max(2 * world.num_processes, 4)
+
+        def batches(start):
+            return (put_batch(mesh, b) for b in synthetic_lm_batches(
+                cfg.vocab_size, global_batch, 16, start_step=start))
+
+        metrics = MetricsWriter(metrics_path) if metrics_path else None
+        result = fit(trainer, batches, rng=jax.random.key(0),
+                     max_steps=steps, metrics=metrics, metrics_every=1,
+                     checkpoint_dir=os.environ.get("KFT_CHECKPOINT_DIR"))
+        print(f"worker {world.process_id}: trained to step "
+              f"{result.final_step} (resumed_from={result.resumed_from})")
+
     print(f"worker {world.process_id}/{world.num_processes}: world ok, "
           f"devices={n_global}, collective={total}")
     return 0

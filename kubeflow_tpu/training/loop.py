@@ -69,6 +69,12 @@ def fit(
     case the first ``resumed_from`` batches are consumed and discarded so a
     restarted job sees the same step->batch mapping as an uninterrupted one.
     """
+    # operator contract: pods get KFT_HEARTBEAT_FILE injected; beating it
+    # per step is what feeds fault detection and the submit->first-step
+    # latency metric without any explicit wiring in user code
+    if heartbeat is None and os.environ.get("KFT_HEARTBEAT_FILE"):
+        heartbeat = Heartbeat(os.environ["KFT_HEARTBEAT_FILE"])
+
     trainer.init_state(rng)
     resumed_from = None
     mgr = None
