@@ -23,6 +23,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional, Protocol
 
@@ -41,6 +42,9 @@ class Pod:
     labels: dict[str, str]
     env: dict[str, str]
     command: list[str]
+    # initContainer role: runs to completion before `command` starts (the
+    # reference's storage-initializer injection, SURVEY.md §2.4)
+    init_command: list[str] = dataclasses.field(default_factory=list)
     phase: PodPhase = PodPhase.PENDING
     exit_code: Optional[int] = None
     node: Optional[str] = None
@@ -138,9 +142,11 @@ class LocalProcessCluster:
     def __init__(self, log_dir: str = "/tmp/kft-pods"):
         self.pods: dict[tuple[str, str], Pod] = {}
         self.procs: dict[tuple[str, str], subprocess.Popen] = {}
+        self.init_procs: dict[tuple[str, str], subprocess.Popen] = {}
         self.services: dict[tuple[str, str], Service] = {}
         self.ports: dict[tuple[str, str], int] = {}
         self.log_dir = log_dir
+        self._lock = threading.Lock()   # pods/procs dicts vs async init
         os.makedirs(log_dir, exist_ok=True)
 
     def create_pod(self, pod: Pod) -> None:
@@ -155,24 +161,65 @@ class LocalProcessCluster:
         env = dict(os.environ)
         env.update(pod.env)
         log = open(os.path.join(self.log_dir, f"{pod.name}.log"), "wb")
-        proc = subprocess.Popen(
-            pod.command or [sys.executable, "-c", "pass"],
-            env=env, stdout=log, stderr=subprocess.STDOUT,
-        )
-        self.procs[key] = proc
-        pod.phase = PodPhase.RUNNING
-        pod.node = "localhost"
+
+        def _launch():
+            # caller holds self._lock (or no init thread exists yet)
+            proc = subprocess.Popen(
+                pod.command or [sys.executable, "-c", "pass"],
+                env=env, stdout=log, stderr=subprocess.STDOUT,
+            )
+            self.procs[key] = proc
+            pod.phase = PodPhase.RUNNING
+            pod.node = "localhost"
+
+        if pod.init_command:
+            # initContainer semantics: pod stays Pending while the init step
+            # runs (async — a slow storage download must not block the
+            # reconcile loop), then the main command starts. The lock closes
+            # the race with delete_pod: a deleted pod's init is killed and
+            # its main command never launches.
+            def _init_then_launch():
+                init = subprocess.Popen(
+                    pod.init_command, env=env, stdout=log,
+                    stderr=subprocess.STDOUT)
+                with self._lock:
+                    if key not in self.pods:
+                        init.kill()
+                        log.close()
+                        return
+                    self.init_procs[key] = init
+                rc = init.wait()
+                with self._lock:
+                    self.init_procs.pop(key, None)
+                    if key not in self.pods:
+                        log.close()
+                        return
+                    if rc != 0:
+                        pod.phase = PodPhase.FAILED
+                        pod.exit_code = rc
+                        log.close()
+                        return
+                    _launch()
+
+            threading.Thread(target=_init_then_launch, daemon=True).start()
+        else:
+            with self._lock:
+                _launch()
 
     def delete_pod(self, namespace, name):
         key = (namespace, name)
-        proc = self.procs.pop(key, None)
+        with self._lock:
+            init = self.init_procs.pop(key, None)
+            proc = self.procs.pop(key, None)
+            self.pods.pop(key, None)
+        if init and init.poll() is None:
+            init.kill()
         if proc and proc.poll() is None:
             proc.send_signal(signal.SIGTERM)
             try:
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        self.pods.pop(key, None)
 
     def get_pod(self, namespace, name):
         key = (namespace, name)
@@ -208,7 +255,22 @@ class LocalProcessCluster:
     def get_service(self, namespace, name):
         return self.services.get((namespace, name))
 
+    def allocate_port(self) -> int:
+        """Per-pod port allocation — the pod-IP analogue on one machine.
+        Controllers stamp each pod's bind address with this so replicas
+        never collide on a port."""
+        return _free_port()
+
     def resolve(self, namespace, service):
+        # Endpoint semantics: a Service resolves to a RUNNING pod matching
+        # its selector (via the pod's stamped bind address); fall back to
+        # the service's own allocated port when no endpoint is up yet.
+        svc = self.services.get((namespace, service))
+        if svc is not None:
+            for pod in self.list_pods(namespace, svc.selector):
+                if pod is not None and pod.phase == PodPhase.RUNNING \
+                        and pod.env.get("KFT_BIND"):
+                    return pod.env["KFT_BIND"]
         return f"127.0.0.1:{self.ports[(namespace, service)]}"
 
     def pod_log(self, namespace: str, name: str) -> str:
@@ -219,5 +281,5 @@ class LocalProcessCluster:
             return f.read().decode(errors="replace")
 
     def shutdown(self):
-        for key in list(self.procs):
+        for key in list(self.pods):    # pods, not procs: reaps mid-init pods
             self.delete_pod(*key)

@@ -9,6 +9,7 @@ the previous ready revision and the canary revision.
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Optional
 
@@ -125,18 +126,25 @@ class ServingController:
                 isvc.status.conditions.append(msg)
             return isvc
 
+        if self.cluster.get_service(namespace, isvc.name) is None:
+            self.cluster.create_service(Service(
+                name=isvc.name, namespace=namespace,
+                selector={"isvc": isvc.name}, port=8080))
+
         if self._applied_generation.get(key) != isvc.generation:
             isvc.status.latest_revision += 1
             self._applied_generation[key] = isvc.generation
             self._create_revision_pods(isvc, runtime,
                                        isvc.status.latest_revision)
 
-        if self.cluster.get_service(namespace, isvc.name) is None:
-            self.cluster.create_service(Service(
-                name=isvc.name, namespace=namespace,
-                selector={"isvc": isvc.name}, port=8080))
-
         latest = isvc.status.latest_revision
+        # Deployment-style self-healing: failed pods of the active revision
+        # are deleted and recreated (predictors get a fresh bind port, which
+        # also heals a lost port race between allocation and server start)
+        for pod in self._pods(isvc, revision=latest):
+            if pod.phase == PodPhase.FAILED:
+                self.cluster.delete_pod(isvc.namespace, pod.name)
+        self._create_revision_pods(isvc, runtime, latest)
         if self._revision_ready(isvc, latest):
             prev = isvc.status.ready_revision
             canary = isvc.predictor.canary_traffic_percent
@@ -182,33 +190,52 @@ class ServingController:
         return self.runtimes.select(isvc.predictor.model_format,
                                     isvc.namespace)
 
+    def _bind_for_pod(self) -> str:
+        """Per-pod bind address. Clusters with an allocate_port hook (local
+        processes sharing one host) get a distinct port per pod — the pod-IP
+        analogue; real-cluster renderers bind the container port."""
+        alloc = getattr(self.cluster, "allocate_port", None)
+        return f"127.0.0.1:{alloc()}" if alloc else "0.0.0.0:8080"
+
     def _create_revision_pods(self, isvc: InferenceService,
                               runtime: ServingRuntime, revision: int) -> None:
-        components: list[tuple[str, int, dict]] = [
-            ("predictor", isvc.predictor.min_replicas, {
-                **runtime.env, **isvc.predictor.env,
-                "KFT_MODEL_FORMAT": isvc.predictor.model_format.name,
-                "KFT_STORAGE_URI": isvc.predictor.storage_uri or "",
-                "KFT_COMPILE_CACHE": runtime.compile_cache_dir or "",
-            }),
+        predictor_env = {
+            **runtime.env, **isvc.predictor.env,
+            "KFT_MODEL_NAME": isvc.name,
+            "KFT_MODEL_FORMAT": isvc.predictor.model_format.name,
+            "KFT_STORAGE_URI": isvc.predictor.storage_uri or "",
+            "KFT_COMPILE_CACHE": runtime.compile_cache_dir or "",
+        }
+        predictor_env.setdefault("KFT_MODEL_DIR", "/mnt/models")
+        # storage-initializer injection (the reference does this in a pod
+        # webhook; here the ISVC controller stamps the init step directly)
+        init_cmd = ([sys.executable, "-m", "kubeflow_tpu.serving.runtime",
+                     "--init-only"] if isvc.predictor.storage_uri else [])
+        components: list[tuple[str, int, dict, list]] = [
+            ("predictor", isvc.predictor.min_replicas, predictor_env,
+             init_cmd),
         ]
         if isvc.transformer:
             components.append(
                 ("transformer", isvc.transformer.min_replicas,
-                 dict(isvc.transformer.env)))
+                 dict(isvc.transformer.env), []))
         if isvc.explainer:
             components.append(
                 ("explainer", isvc.explainer.min_replicas,
-                 dict(isvc.explainer.env)))
-        for comp, replicas, env in components:
+                 dict(isvc.explainer.env), []))
+        for comp, replicas, env, init in components:
             for i in range(replicas):
                 pname = _pod_name(isvc, comp, revision, i)
                 if self.cluster.get_pod(isvc.namespace, pname) is None:
+                    pod_env = dict(env)
+                    if comp == "predictor":
+                        pod_env["KFT_BIND"] = self._bind_for_pod()
                     self.cluster.create_pod(Pod(
                         name=pname, namespace=isvc.namespace,
                         labels={"isvc": isvc.name, "component": comp,
                                 "revision": str(revision)},
-                        env=env, command=list(runtime.command)))
+                        env=pod_env, command=list(runtime.command),
+                        init_command=init))
 
     def _pods(self, isvc: InferenceService,
               revision: Optional[int] = None) -> list[Pod]:

@@ -110,7 +110,8 @@ class LLMModel(Model):
     def __init__(self, name: str, params, cfg, *, max_batch: int = 8,
                  max_seq: int = 1024, pad_id: int = 0,
                  compile_cache_dir: Optional[str] = None,
-                 prefill_buckets: Sequence[int] = (64, 128, 256, 512)):
+                 prefill_buckets: Sequence[int] = (64, 128, 256, 512),
+                 tokenizer=None, request_timeout: float = 600.0):
         super().__init__(name)
         self._params = params
         self.cfg = cfg
@@ -119,10 +120,30 @@ class LLMModel(Model):
         self.pad_id = pad_id
         self.compile_cache_dir = compile_cache_dir
         self.prefill_buckets = prefill_buckets
+        self.tokenizer = tokenizer
+        self.request_timeout = request_timeout
         self.engine: Optional[LLMEngine] = None
         self._wake = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._shutdown = False
+
+    @classmethod
+    def from_pretrained(cls, name: str, model_dir: str, *,
+                        dtype=None, mesh=None, **kw) -> "LLMModel":
+        """Build from an HF-layout checkpoint directory (config.json +
+        model*.safetensors [+ tokenizer.json]) — the real-weights serving
+        path ([U] kserve:python/huggingfaceserver). Text in/text out when a
+        tokenizer is present; token ids otherwise."""
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import hf_llama
+        from kubeflow_tpu.serving.tokenizer import load_tokenizer
+
+        cfg, params = hf_llama.load_pretrained(
+            model_dir, dtype=dtype or jnp.bfloat16, mesh=mesh)
+        tok = load_tokenizer(model_dir)
+        kw.setdefault("max_seq", min(cfg.max_seq, 1024))
+        return cls(name, params, cfg, tokenizer=tok, **kw)
 
     def load(self) -> bool:
         if self.compile_cache_dir:
@@ -161,25 +182,34 @@ class LLMModel(Model):
                 self._wake.notify_all()
 
     def predict(self, request: InferRequest) -> InferResponse:
-        ids = request.as_numpy()
-        if ids.ndim == 1:
-            ids = ids[None, :]
+        arr = request.as_numpy()
         p = request.parameters
+        text_in = arr.dtype.kind in ("U", "S", "O")
+        if text_in and self.tokenizer is None:
+            raise ValueError(
+                f"model {self.name!r} has no tokenizer; send token ids")
+        eos_default = (self.tokenizer.eos_id
+                       if self.tokenizer is not None else None)
         sampling = SamplingParams(
             max_tokens=int(p.get("max_tokens", 64)),
             temperature=float(p.get("temperature", 0.0)),
             top_k=int(p.get("top_k", 0)),
             top_p=float(p.get("top_p", 1.0)),
-            eos_id=(int(p["eos_id"]) if "eos_id" in p else None),
+            eos_id=(int(p["eos_id"]) if "eos_id" in p else eos_default),
         )
-        prompts = []
-        for row in ids:
-            prompt = [int(t) for t in row]
-            # strip only TRAILING padding — pad_id may be a real token
-            # elsewhere in the sequence
-            while prompt and prompt[-1] == self.pad_id:
-                prompt.pop()
-            prompts.append(prompt)
+        if text_in:
+            texts = [str(t) for t in arr.reshape(-1)]
+            prompts = [self.tokenizer.encode(t, bos=True) for t in texts]
+        else:
+            ids = arr if arr.ndim > 1 else arr[None, :]
+            prompts = []
+            for row in ids:
+                prompt = [int(t) for t in row]
+                # strip only TRAILING padding — pad_id may be a real token
+                # elsewhere in the sequence
+                while prompt and prompt[-1] == self.pad_id:
+                    prompt.pop()
+                prompts.append(prompt)
         # validate EVERY row before enqueuing ANY: a mid-batch rejection must
         # not leave earlier rows generating with no caller to collect them
         for prompt in prompts:
@@ -191,14 +221,25 @@ class LLMModel(Model):
             self._wake.notify_all()
         with self._wake:
             self._wake.wait_for(lambda: all(r.done for r in reqs)
-                                or self._shutdown, timeout=600)
+                                or self._shutdown,
+                                timeout=self.request_timeout)
         if not all(r.done for r in reqs):
+            # free the decode slots before surfacing the failure — otherwise
+            # the timed-out requests occupy slots until max_tokens
+            self.engine.abort(reqs)
+            with self._wake:
+                self._wake.notify_all()
             raise TimeoutError("generation did not finish")
+        lengths = np.asarray([len(r.generated) for r in reqs], np.int32)
+        outputs: dict[str, np.ndarray] = {}
+        if text_in:
+            outputs["text"] = np.asarray(
+                [self.tokenizer.decode(r.generated) for r in reqs],
+                dtype=object)
         max_new = max(len(r.generated) for r in reqs)
         tokens = np.full((len(reqs), max_new), self.pad_id, np.int32)
-        lengths = np.zeros((len(reqs),), np.int32)
         for i, r in enumerate(reqs):
             tokens[i, :len(r.generated)] = r.generated
-            lengths[i] = len(r.generated)
-        return InferResponse.from_numpy(
-            self.name, {"tokens": tokens, "lengths": lengths}, id=request.id)
+        outputs["tokens"] = tokens
+        outputs["lengths"] = lengths
+        return InferResponse.from_numpy(self.name, outputs, id=request.id)

@@ -45,10 +45,13 @@ class GenRequest:
     sampling: SamplingParams
     generated: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    aborted: bool = False
     slot: Optional[int] = None
 
     @property
     def finish_reason(self) -> str:
+        if self.aborted:
+            return "abort"
         if self.sampling.eos_id is not None and self.generated and \
                 self.generated[-1] == self.sampling.eos_id:
             return "stop"
@@ -77,8 +80,11 @@ def sample_logits(logits, rng, temperature, top_k, top_p):
     kth = jnp.take_along_axis(sorted_asc, k_idx[:, None], axis=-1)
     kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
     scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-    # top-p (nucleus): drop the tail whose cumulative prob exceeds p
-    sorted_desc = sorted_asc[:, ::-1]
+    # top-p (nucleus) over the top-k-MASKED distribution (vLLM/HF ordering:
+    # k first, then p renormalized on the survivors). The mask is a monotone
+    # value threshold, so the sorted masked array comes from the existing
+    # sort — no second O(V log V) sort in the decode hot loop.
+    sorted_desc = jnp.where(sorted_asc < kth, -jnp.inf, sorted_asc)[:, ::-1]
     probs = jax.nn.softmax(sorted_desc, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.minimum(
@@ -107,6 +113,7 @@ class LLMEngine:
         self._free: list[int] = list(range(max_batch))
         self._active: dict[int, GenRequest] = {}     # slot -> request
         self._waiting: list[GenRequest] = []
+        self._aborted: set[int] = set()              # request ids to retire
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._tokens = np.zeros((max_batch,), np.int32)   # next input token
@@ -164,6 +171,20 @@ class LLMEngine:
             self._waiting.append(req)
         return req
 
+    def abort(self, reqs: Sequence[GenRequest]) -> None:
+        """Give up on requests (caller timeout / disconnect): waiting ones
+        leave the queue immediately; active ones release their slot at the
+        start of the next step. Without this, a timed-out caller's slots
+        would stay occupied until max_tokens (ADVICE r1 finding c)."""
+        ids = set()
+        for r in reqs:
+            r.aborted = True
+            r.done = True
+            ids.add(r.id)
+        with self._lock:
+            self._waiting = [r for r in self._waiting if r.id not in ids]
+            self._aborted.update(ids)
+
     def has_work(self) -> bool:
         with self._lock:
             return bool(self._waiting or self._active)
@@ -171,6 +192,13 @@ class LLMEngine:
     def step(self) -> list[GenRequest]:
         """Admit waiting requests, run one decode step, retire finished.
         Returns requests that finished this step."""
+        with self._lock:
+            aborted, self._aborted = self._aborted, set()
+        if aborted:
+            for slot, req in list(self._active.items()):
+                if req.id in aborted:
+                    del self._active[slot]
+                    self._free.append(slot)
         self._admit()
         if not self._active:
             return []

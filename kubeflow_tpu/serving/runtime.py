@@ -1,0 +1,96 @@
+"""Predictor runtime entrypoint — env contract -> storage init -> server.
+
+Parity: SURVEY.md §2.4 — the reference's predictor container runs
+`kserve.ModelServer` after a storage-initializer initContainer has
+materialized `storageUri` at /mnt/models ([U] kserve:pkg/webhook storage
+initializer injection + python/kserve model server main). Here the same
+contract is one module:
+
+- the ISVC controller stamps predictor pods with KFT_STORAGE_URI /
+  KFT_MODEL_DIR / KFT_MODEL_FORMAT / KFT_BIND and an init step running
+  ``python -m kubeflow_tpu.serving.runtime --init-only`` (the
+  initContainer role);
+- ``python -m kubeflow_tpu.serving.runtime`` is the container command:
+  builds the model for the declared format and serves V1+V2 HTTP.
+
+Env contract (all optional except the uri for real weights):
+  KFT_MODEL_NAME    served name              (default "model")
+  KFT_MODEL_FORMAT  "llama" | "jax"          (default "llama")
+  KFT_STORAGE_URI   file:// pvc:// http(s):// hf://
+  KFT_MODEL_DIR     materialization dir      (default /mnt/models)
+  KFT_BIND          host:port to serve on    (default 127.0.0.1:8080)
+  KFT_DTYPE         "bfloat16" | "float32"   (default bfloat16)
+  KFT_MAX_BATCH / KFT_MAX_SEQ    engine sizing
+  KFT_COMPILE_CACHE persistent XLA compile cache dir
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+from typing import Mapping, Optional
+
+from kubeflow_tpu.serving import storage
+from kubeflow_tpu.serving.jax_model import LLMModel
+from kubeflow_tpu.serving.model import Model, ModelRepository
+from kubeflow_tpu.serving.server import ModelServer
+
+
+def init_storage(env: Mapping[str, str]) -> Optional[str]:
+    """The storage-initializer step: materialize KFT_STORAGE_URI into
+    KFT_MODEL_DIR and return the local path (None when no uri is set).
+    Idempotent — safe to run in both the init step and the server."""
+    uri = env.get("KFT_STORAGE_URI") or ""
+    if not uri:
+        return env.get("KFT_MODEL_DIR") or None
+    dest = env.get("KFT_MODEL_DIR") or "/mnt/models"
+    return storage.download(uri, dest)
+
+
+def build_model_from_env(env: Mapping[str, str]) -> Model:
+    """Construct the Model the env contract describes (runtime selection
+    having already happened in the ISVC controller)."""
+    import jax.numpy as jnp
+
+    name = env.get("KFT_MODEL_NAME", "model")
+    fmt = (env.get("KFT_MODEL_FORMAT") or "llama").lower()
+    model_dir = init_storage(env)
+    cache = env.get("KFT_COMPILE_CACHE") or None
+    if fmt in ("llama", "llm", "huggingface"):
+        if not model_dir:
+            raise ValueError("llama format needs KFT_STORAGE_URI/KFT_MODEL_DIR")
+        dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                 "float16": jnp.float16}[env.get("KFT_DTYPE", "bfloat16")]
+        return LLMModel.from_pretrained(
+            name, model_dir, dtype=dtype,
+            max_batch=int(env.get("KFT_MAX_BATCH", 8)),
+            max_seq=int(env.get("KFT_MAX_SEQ", 1024)),
+            compile_cache_dir=cache)
+    raise ValueError(f"unsupported KFT_MODEL_FORMAT {fmt!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow_tpu.serving.runtime")
+    ap.add_argument("--init-only", action="store_true",
+                    help="run the storage-initializer step and exit")
+    args = ap.parse_args(argv)
+    env = os.environ
+    if args.init_only:
+        path = init_storage(env)
+        print(f"storage-initializer: materialized {path}", flush=True)
+        return 0
+    model = build_model_from_env(env)
+    repo = ModelRepository()
+    repo.register(model)               # load()s eagerly: warm before ready
+    bind = env.get("KFT_BIND", "127.0.0.1:8080")
+    host, _, port = bind.rpartition(":")
+    server = ModelServer(repo, host=host or "127.0.0.1", port=int(port))
+    server.start()
+    print(f"serving {model.name!r} at {server.url}", flush=True)
+    threading.Event().wait()           # serve until killed
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

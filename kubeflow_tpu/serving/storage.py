@@ -8,6 +8,8 @@ of a hard import.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
 import tarfile
@@ -15,11 +17,43 @@ import urllib.parse
 import urllib.request
 import zipfile
 
+_MARKER = ".kft_materialized.json"
+
+
+def _marker_path(dest_dir: str) -> str:
+    return os.path.join(dest_dir, _MARKER)
+
+
+def _already_materialized(storage_uri: str, dest_dir: str):
+    """Remote downloads are recorded with a marker so the init step and the
+    server (which both call download) don't fetch the artifact twice."""
+    try:
+        with open(_marker_path(dest_dir)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("uri_sha") != _uri_sha(storage_uri):
+        return None
+    path = doc.get("path")
+    return path if path and os.path.exists(path) else None
+
+
+def _uri_sha(storage_uri: str) -> str:
+    return hashlib.sha256(storage_uri.encode()).hexdigest()[:16]
+
+
+def _record(storage_uri: str, dest_dir: str, path: str) -> str:
+    with open(_marker_path(dest_dir), "w") as f:
+        json.dump({"uri_sha": _uri_sha(storage_uri), "path": path}, f)
+    return path
+
 
 def download(storage_uri: str, dest_dir: str) -> str:
     """Materialize the model behind ``storage_uri`` into ``dest_dir`` and
     return the local path (the storage-initializer initContainer contract:
-    runs before the server starts, mounts at /mnt/models)."""
+    runs before the server starts, mounts at /mnt/models). Idempotent for
+    remote schemes: a completed download leaves a marker and later calls
+    return immediately."""
     os.makedirs(dest_dir, exist_ok=True)
     parsed = urllib.parse.urlparse(storage_uri)
     scheme = parsed.scheme or "file"
@@ -31,13 +65,19 @@ def download(storage_uri: str, dest_dir: str) -> str:
         path = os.path.join("/mnt/pvc", parsed.netloc,
                             parsed.path.lstrip("/"))
         return _from_local(path, dest_dir)
+    done = _already_materialized(storage_uri, dest_dir)
+    if done is not None:
+        return done
     if scheme in ("http", "https"):
         fname = os.path.basename(parsed.path) or "model"
         target = os.path.join(dest_dir, fname)
         urllib.request.urlretrieve(storage_uri, target)
-        return _maybe_unpack(target, dest_dir)
+        return _record(storage_uri, dest_dir,
+                       _maybe_unpack(target, dest_dir))
     if scheme == "hf":
-        return _from_huggingface(parsed.netloc + parsed.path, dest_dir)
+        return _record(
+            storage_uri, dest_dir,
+            _from_huggingface(parsed.netloc + parsed.path, dest_dir))
     if scheme in ("gs", "s3", "azure"):
         raise RuntimeError(
             f"{scheme}:// downloads need the cloud SDK, which is not in "

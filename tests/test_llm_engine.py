@@ -148,3 +148,66 @@ def test_llm_concurrent_requests_batch(tiny):
     model.unload()
     assert results["a"] == ref_greedy(params, cfg, [5, 6, 7], 5)
     assert results["b"] == ref_greedy(params, cfg, [9, 10, 11], 5)
+
+
+def test_topp_applied_after_topk():
+    """ADVICE r1(a) regression: the nucleus cutoff must be computed on the
+    top-k-masked, renormalized distribution (vLLM/HF semantics). With probs
+    [0.4, 0.3, 0.2, 0.1], top_k=2 renormalizes to [0.571, 0.429]; top_p=0.5
+    then keeps ONLY the argmax. The pre-fix code computed the cutoff from
+    the unmasked distribution (cum [0.4, 0.7, ...]) and kept two tokens."""
+    probs = jnp.asarray([[0.4, 0.3, 0.2, 0.1]])
+    logits = jnp.log(probs)
+    for seed in range(64):
+        tok = sample_logits(
+            logits, jax.random.key(seed), jnp.ones(1),
+            jnp.full((1,), 2, jnp.int32), jnp.full((1,), 0.5))
+        assert int(tok[0]) == 0
+
+
+def test_abort_frees_slots(tiny):
+    """ADVICE r1(c) regression: aborting an in-flight request releases its
+    decode slot so later requests are not starved."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=1, max_seq=64,
+                    prefill_buckets=(8,))
+    a = eng.add_request([5, 6, 7], SamplingParams(max_tokens=1000))
+    eng.step()
+    assert not eng._free                      # slot occupied by a
+    eng.abort([a])
+    assert a.done and a.finish_reason == "abort"
+    b = eng.add_request([9, 10], SamplingParams(max_tokens=4))
+    while eng.has_work():
+        eng.step()
+    assert b.done and len(b.generated) == 4
+    assert len(eng._free) == 1                # slot came back
+
+
+def test_llm_model_timeout_aborts(tiny):
+    """A predict() timeout must not leave orphaned requests in the engine."""
+    cfg, params = tiny
+    model = LLMModel("llm", params, cfg, max_batch=1, max_seq=64,
+                     prefill_buckets=(8,), request_timeout=0.0)
+    model.load()
+    try:
+        from kubeflow_tpu.serving import InferRequest, InferTensor
+
+        req = InferRequest("llm", inputs=[InferTensor(
+            "input-0", [3], "INT32", [5, 6, 7])],
+            parameters={"max_tokens": 500})
+        with pytest.raises(TimeoutError):
+            model.predict(req)
+        # engine drains (aborted request retired), slot available again
+        import time as _t
+        t0 = _t.time()
+        while model.engine.has_work() and _t.time() - t0 < 10:
+            _t.sleep(0.05)
+        assert not model.engine.has_work()
+        model.request_timeout = 60.0
+        req2 = InferRequest("llm", inputs=[InferTensor(
+            "input-0", [2], "INT32", [9, 10])],
+            parameters={"max_tokens": 3})
+        out = model.predict(req2).as_numpy("tokens")
+        assert out.shape == (1, 3)
+    finally:
+        model.unload()

@@ -284,3 +284,24 @@ def test_graph_validation():
             "root": GraphNode(GraphNodeType.SEQUENCE,
                               steps=[GraphStep(node="missing")])
         }).validate()
+
+
+def test_failed_predictor_pod_restarted():
+    """Deployment-style self-healing: a FAILED pod of the active revision is
+    deleted and recreated on the next reconcile (fresh bind port)."""
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(_runtime())
+    ctl = ServingController(cluster, reg)
+    isvc = InferenceService(name="m", predictor=PredictorSpec())
+    ctl.apply(isvc)
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert isvc.status.ready
+    [(key, pod)] = [kv for kv in cluster.pods.items()
+                    if kv[1].labels["component"] == "predictor"]
+    cluster.set_phase(key[0], pod.name, PodPhase.FAILED, exit_code=1)
+    ctl.reconcile("default", "m")
+    pods = [p for p in cluster.pods.values()
+            if p.labels["component"] == "predictor"]
+    assert len(pods) == 1 and pods[0].phase == PodPhase.PENDING
