@@ -97,6 +97,11 @@ class Operator:
         self.serving_period = serving_period
         self._submit_times: dict[tuple[str, str], float] = {}
         self._first_step_seen: set[tuple[str, str]] = set()
+        # One lock serializes every compound mutation of controller state
+        # (submit / delete / reconcile / heartbeat sweep): the reconcile,
+        # heartbeat, and HTTP threads otherwise interleave read-modify-write
+        # sequences. Contention is negligible at these loop periods.
+        self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -120,9 +125,14 @@ class Operator:
     # ---------------- job API (the apiserver role) ----------------
 
     def submit(self, job) -> None:
-        self.controller.submit(job)
-        self._submit_times[(job.namespace, job.name)] = time.time()
+        with self._lock:
+            self.controller.submit(job)
+            self._submit_times[(job.namespace, job.name)] = time.time()
         self.metrics.inc("kft_jobs_submitted_total")
+
+    def delete(self, ns: str, name: str) -> None:
+        with self._lock:
+            self.controller.delete(ns, name)
 
     # ---------------- loops ----------------
 
@@ -135,7 +145,8 @@ class Operator:
             for ns, name in keys:
                 t0 = time.perf_counter()
                 try:
-                    job = self.controller.reconcile(ns, name)
+                    with self._lock:
+                        job = self.controller.reconcile(ns, name)
                 except Exception:
                     self.metrics.inc("kft_reconcile_errors_total")
                     continue
@@ -161,7 +172,9 @@ class Operator:
     def _heartbeat_loop(self):
         while not self._stop.wait(self.heartbeat_period):
             for (ns, name) in list(self.controller.jobs.keys()):
-                stale = check_heartbeats(self.controller, ns, name, self.tracker)
+                with self._lock:
+                    stale = check_heartbeats(
+                        self.controller, ns, name, self.tracker)
                 if stale:
                     self.metrics.inc("kft_heartbeat_stale_total", by=len(stale))
                 self._record_first_step(ns, name)
@@ -310,7 +323,7 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
             ns, name = self._job_path()
             if not (ns and name):
                 return self._send(404, '{"error": "unknown path"}')
-            op.controller.delete(ns, name)
+            op.delete(ns, name)
             self._send(200, "{}")
 
     return ThreadingHTTPServer(("127.0.0.1", port), Handler)

@@ -225,7 +225,10 @@ class ExperimentController:
                     self.runner.kill(t, exp)
 
         counts = exp.counts()
-        if counts[TrialState.FAILED] > exp.max_failed_trial_count:
+        # Katib semantics: the experiment fails when the failed-trial count
+        # *reaches* the budget (not budget+1); 0 means zero tolerance.
+        if counts[TrialState.FAILED] > 0 and \
+                counts[TrialState.FAILED] >= exp.max_failed_trial_count:
             exp.failed = True
             exp.completion_reason = "MaxFailedTrialCountExceeded"
             self._kill_running()

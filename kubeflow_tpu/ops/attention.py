@@ -42,9 +42,6 @@ def _xla_attention(q, k, v, *, causal: bool, q_offset=0, bias=None):
     return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "impl", "block_q", "block_kv")
-)
 def attention(
     q,
     k,
@@ -60,13 +57,35 @@ def attention(
 
     q: [B, Sq, H, D]; k, v: [B, Skv, KV_H, D] with H % KV_H == 0.
     `q_offset` shifts query positions for causal masking during decode.
+
+    Validation happens out here, unjitted: under jit an explicitly-passed
+    q_offset=0 would trace to a Tracer and defeat the isinstance check.
     """
-    platform = jax.default_backend()
     if impl in ("flash", "pallas") and not (
             isinstance(q_offset, int) and q_offset == 0):
         raise ValueError(
             f"impl={impl!r} does not support q_offset; use impl='xla' "
             "(decode paths use decode_attention)")
+    return _attention_jit(q, k, v, causal=causal, impl=impl,
+                          q_offset=q_offset, block_q=block_q,
+                          block_kv=block_kv)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "impl", "block_q", "block_kv")
+)
+def _attention_jit(
+    q,
+    k,
+    v,
+    *,
+    causal: bool,
+    impl: str,
+    q_offset,
+    block_q: int,
+    block_kv: int,
+):
+    platform = jax.default_backend()
     if impl == "flash":
         if platform != "tpu":
             # the stock kernel has no interpreter path; xla is the

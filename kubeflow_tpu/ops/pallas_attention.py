@@ -286,6 +286,10 @@ def _flash_fwd(q, k, v, causal, block_q, block_kv, kv_len, interpret):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
 def _pad_seq(x, block):
     s = x.shape[2]
     pad = (-s) % block
@@ -308,8 +312,12 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
     _, skv, kvh, _ = k.shape
     if h % kvh:
         raise ValueError(f"H={h} not a multiple of KV_H={kvh}")
-    block_q = min(block_q, max(sq, 8))
-    block_kv = min(block_kv, max(skv, 8))
+    # Clamp blocks to the (rounded-up) sequence length, keeping TPU tiling
+    # alignment: short sequences round up to one 128-lane block and any
+    # caller-supplied block stays a multiple of 8 sublanes; the zero-pad +
+    # in-kernel masking absorbs the extra rows.
+    block_q = _round_up(min(block_q, _round_up(sq, 128)), 8)
+    block_kv = _round_up(min(block_kv, _round_up(skv, 128)), 128)
     qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)    # [B, H, S', D]
     kt = _pad_seq(k.transpose(0, 2, 1, 3), block_kv)
     vt = _pad_seq(v.transpose(0, 2, 1, 3), block_kv)

@@ -25,3 +25,10 @@ from kubeflow_tpu.parallel.pipeline import (
     pipeline_loss_fn,
     stack_stage_params,
 )
+from kubeflow_tpu.parallel.pipeline_llama import (
+    init_pipeline_params,
+    pipeline_forward,
+    pipeline_lm_loss_fn,
+    pipeline_param_logical_axes,
+    to_pipeline_params,
+)

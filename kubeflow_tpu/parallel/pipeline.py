@@ -43,15 +43,26 @@ def pipeline_apply(
     axis: str = "pipeline",
     microbatches: int,
     batch_spec: P = P(),
+    partial_manual: bool = False,
+    stage_aux: bool = False,
 ) -> Callable:
     """Build ``fn(stacked_params, x) -> y`` running stage_fn as a pipeline.
 
     - ``stage_fn(stage_params, x) -> y``: one stage's computation; x/y have
-      identical shapes (the inter-stage activation contract).
+      identical shapes (the inter-stage activation contract). With
+      ``stage_aux`` it returns ``(y, aux_scalar)`` — e.g. MoE load-balance
+      penalties — and the pipelined fn returns ``(y, aux_total)`` where
+      aux_total averages the per-microbatch stage penalties (bubble ticks on
+      zero-injected activations are masked out).
     - ``stacked_params``: pytree with leading stage dim (see
       stack_stage_params), sharded P(axis) on dim 0.
     - ``x``: [batch, ...] global batch; split into ``microbatches`` equal
       microbatches along dim 0.
+    - ``partial_manual``: only the pipeline axis is manual in the shard_map;
+      every other mesh axis stays auto, so stage_fn may contain its own
+      sharding constraints (expert all-to-alls, TP splits) which XLA places
+      over the remaining axes. This is how PP composes with EP/DP/TP in one
+      jitted program.
 
     Returns the pipelined function (jit-able; grads flow through ppermute).
     """
@@ -71,13 +82,21 @@ def pipeline_apply(
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            buf, out = carry
+            buf, out, aux_acc = carry
             # stage 0 ingests microbatch t (zeros once input is exhausted)
             inject = mb[jnp.minimum(t, microbatches - 1)]
             inject = jnp.where(t < microbatches, inject,
                                jnp.zeros_like(inject))
             state_in = jnp.where(stage == 0, inject, buf)
-            y = stage_fn(local_params, state_in)
+            if stage_aux:
+                y, aux = stage_fn(local_params, state_in)
+                # stage s holds real data for microbatch t-s only while
+                # s <= t < s+M; bubble ticks run on zeros and are masked
+                valid = ((t >= stage) & (t - stage < microbatches))
+                aux_acc = aux_acc + jnp.where(
+                    valid, aux.astype(jnp.float32), 0.0)
+            else:
+                y = stage_fn(local_params, state_in)
             # the LAST stage's output for microbatch t-(S-1) is ready now
             out_idx = t - (n_stages - 1)
             out = jnp.where(
@@ -86,23 +105,37 @@ def pipeline_apply(
                 out)
             # stream activations to the next stage (ring; last->0 ignored)
             buf = jax.lax.ppermute(y, axis, fwd_perm)
-            return (buf, out), None
+            return (buf, out, aux_acc), None
 
         buf0 = jnp.zeros(mb_shape, x.dtype)
         out0 = jnp.zeros((microbatches, *mb_shape), x.dtype)
-        (_, out), _ = jax.lax.scan(
-            tick, (buf0, out0), jnp.arange(total))
+        (_, out, aux_acc), _ = jax.lax.scan(
+            tick, (buf0, out0, jnp.zeros((), jnp.float32)),
+            jnp.arange(total))
         # collected on the last stage; psum-broadcast so the result is
         # replicated over the pipeline axis (loss computed everywhere)
         out = jax.lax.psum(
             jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)),
             axis)
-        return jnp.reshape(out, (x.shape[0], *mb_shape[1:]))
+        out = jnp.reshape(out, (x.shape[0], *mb_shape[1:]))
+        if stage_aux:
+            # sum every stage's penalty, average over microbatches (each
+            # microbatch's aux is already a per-token mean)
+            return out, jax.lax.psum(aux_acc, axis) / microbatches
+        return out
 
-    # params: stage dim over the pipeline axis (a prefix spec covers every
-    # leaf); activations replicated over it, sharded per batch_spec elsewhere
+    out_specs = (batch_spec, P()) if stage_aux else batch_spec
     kwargs = dict(mesh=mesh, in_specs=(P(axis), batch_spec),
-                  out_specs=batch_spec)
+                  out_specs=out_specs)
+    if partial_manual:
+        # jax >= 0.9: axis_names = the manual subset; the rest stays auto
+        try:
+            return shard_map(impl, axis_names=frozenset({axis}),
+                             check_vma=False, **kwargs)
+        except TypeError as e:
+            raise RuntimeError(
+                "partial_manual pipeline_apply needs jax>=0.9 "
+                "(shard_map axis_names support)") from e
     try:
         return shard_map(impl, check_vma=False, **kwargs)   # jax >= 0.8
     except TypeError:

@@ -1,0 +1,121 @@
+"""Pipeline-parallel Llama: PP composed with EP/DP/TP in one jitted program.
+
+The reference runs pipeline stages as separate pods wired by a launcher
+(SURVEY.md §2.7 'PP' — Megatron/DeepSpeed inside user containers). The
+TPU-native composition keeps the whole pipelined model a single SPMD
+program: transformer layers are re-stacked ``[n_stages, L/n_stages, ...]``
+and sharded over the ``pipeline`` mesh axis; inside each stage the usual
+scan-over-layers runs, and because only the pipeline axis is *manual* in the
+shard_map (``partial_manual=True``), the MoE expert all-to-alls and any
+TP/DP layouts still resolve over the remaining (auto) mesh axes. Embedding
+and the LM head run outside the pipeline body, replicated over the pipeline
+axis (their FLOPs are marginal; shared-embedding PP schemes do the same).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.losses import softmax_cross_entropy
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import rope_frequencies
+from kubeflow_tpu.parallel.pipeline import pipeline_apply
+from kubeflow_tpu.parallel.sharding import constrain
+
+# NOTE: kubeflow_tpu.models.llama imports parallel.sharding, so importing it
+# at module scope from inside the parallel package would be circular; the
+# llama symbols are imported lazily inside the functions below.
+
+
+def to_pipeline_params(params, n_stages: int):
+    """Re-stack layer params [L, ...] -> stages [n_stages, L/n_stages, ...].
+
+    Embedding / final norm / head stay top-level (replicated over the
+    pipeline axis by their logical-axis rules)."""
+    L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"n_layers={L} not divisible by n_stages={n_stages}")
+    stages = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_stages, L // n_stages) + a.shape[1:]),
+        params["layers"])
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["stages"] = stages
+    return out
+
+
+def init_pipeline_params(rng, cfg, n_stages: int, dtype=jnp.float32):
+    from kubeflow_tpu.models.llama import init_params
+
+    return to_pipeline_params(init_params(rng, cfg, dtype), n_stages)
+
+
+def pipeline_param_logical_axes(cfg):
+    """Logical axes for the pipeline-arranged param tree: each layer leaf
+    gains a leading 'pipe_stage' axis (rule: the pipeline mesh axis)."""
+    from kubeflow_tpu.models.llama import param_logical_axes
+
+    base = param_logical_axes(cfg)
+    stages = jax.tree_util.tree_map(
+        lambda names: ("pipe_stage",) + tuple(names),
+        base["layers"], is_leaf=lambda x: isinstance(x, tuple))
+    out = {k: v for k, v in base.items() if k != "layers"}
+    out["stages"] = stages
+    return out
+
+
+def pipeline_forward(params, tokens, cfg, mesh, *,
+                     microbatches: int, axis: str = "pipeline"):
+    """Pipelined full-sequence forward: tokens [B,S] -> (logits [B,S,V] f32,
+    aux dict). B must divide by ``microbatches``."""
+    from kubeflow_tpu.models.llama import _block, _remat_wrap
+
+    positions = jnp.arange(tokens.shape[1])[None, :]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+
+    block = _remat_wrap(
+        lambda x, lp: _block(x, lp, inv_freq, positions, cfg), cfg)
+
+    def stage_fn(stage_layers, x):
+        x, aux_per_layer = jax.lax.scan(block, x, stage_layers)
+        return x, jnp.sum(aux_per_layer)
+
+    fwd = pipeline_apply(
+        stage_fn, mesh, axis=axis, microbatches=microbatches,
+        partial_manual=True, stage_aux=True)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "act_embed"))
+    x, moe_aux = fwd(params["stages"], x)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+    logits = constrain(logits, ("batch", "seq", None))
+    return logits.astype(jnp.float32), {"moe_aux": moe_aux}
+
+
+def pipeline_lm_loss_fn(cfg, mesh, *, microbatches: int,
+                        axis: str = "pipeline"):
+    """Next-token LM loss through the pipelined forward (Trainer-compatible:
+    loss_fn(params, batch) -> (loss, metrics))."""
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits, fwd_aux = pipeline_forward(
+            params, inputs, cfg, mesh, microbatches=microbatches, axis=axis)
+        mask = batch.get("mask")
+        mask = mask[:, 1:] if mask is not None else None
+        loss, aux = softmax_cross_entropy(
+            logits, targets, mask, z_loss=getattr(cfg, "z_loss", 0.0))
+        metrics = {"tokens": aux["total_weight"]}
+        if cfg.n_experts:
+            loss = loss + fwd_aux["moe_aux"]
+            metrics["moe_aux"] = fwd_aux["moe_aux"]
+        return loss, metrics
+
+    return loss_fn

@@ -37,6 +37,7 @@ DEFAULT_RULES: dict[str, object] = {
     "kv_heads": "tensor",
     "head_dim": None,
     "layers": None,               # scan-over-layers stacking axis
+    "pipe_stage": "pipeline",     # pipeline-stage stacking axis (PP)
     "expert": "expert",
 }
 

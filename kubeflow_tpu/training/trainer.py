@@ -38,6 +38,15 @@ class TrainerConfig:
     # big models on small HBM (T5X-style default on TPU).
     optimizer: str = "adamw"
     rules: Mapping[str, object] | None = None   # logical->mesh rules override
+    # Metric-key conventions for gradient accumulation (instead of hardcoding
+    # the literal "tokens"): `weight_metric` names the metric holding each
+    # microbatch's loss-normalization weight (token count for LM losses);
+    # loss and grads are re-weighted by it so accumulation reproduces the
+    # GLOBAL token-weighted mean even when mask density varies across
+    # microbatches. `count_metrics` are summed across microbatches; all other
+    # metrics are averaged.
+    weight_metric: str = "tokens"
+    count_metrics: tuple = ("tokens",)
 
 
 def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -163,26 +172,37 @@ class Trainer:
                 mb0 = jax.tree_util.tree_map(lambda x: x[0], micro)
                 _, m_shapes, _ = jax.eval_shape(grads_of, params, mb0)
 
+                # Each microbatch loss is a weighted mean (weight = its token
+                # count, exposed via cfg.weight_metric). Accumulate
+                # UN-normalized sums — loss·w, grads·w, Σw — and divide once,
+                # so the result is the global token-weighted mean regardless
+                # of how mask density varies across microbatches.
+                weight_key = self.config.weight_metric
+
                 def body(carry, mb):
-                    g_acc, loss_acc, m_acc = carry
+                    g_acc, loss_acc, w_acc, m_acc = carry
                     loss, metrics, grads = grads_of(params, mb)
-                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                    w = jnp.asarray(
+                        metrics.get(weight_key, 1.0), jnp.float32)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g * w.astype(g.dtype), g_acc, grads)
                     m_acc = jax.tree_util.tree_map(jnp.add, m_acc, metrics)
-                    return (g_acc, loss_acc + loss, m_acc), None
+                    return (g_acc, loss_acc + loss * w, w_acc + w, m_acc), None
 
                 zeros_g = jax.tree_util.tree_map(jnp.zeros_like, params)
                 zeros_m = jax.tree_util.tree_map(
                     lambda s: jnp.zeros(s.shape, s.dtype), m_shapes
                 )
-                (grads, loss, m_sum), _ = jax.lax.scan(
-                    body, (zeros_g, 0.0, zeros_m), micro
+                (g_sum, loss_sum, w_sum, m_sum), _ = jax.lax.scan(
+                    body, (zeros_g, 0.0, 0.0, zeros_m), micro
                 )
-                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
-                loss = loss / accum
-                # counts ("tokens") sum across microbatches; everything else
-                # (aux losses etc.) is averaged like the loss
+                denom = jnp.maximum(w_sum, 1e-8)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / denom.astype(g.dtype), g_sum)
+                loss = loss_sum / denom
+                counts = set(self.config.count_metrics)
                 metrics = {
-                    k: (v if k == "tokens" else v / accum)
+                    k: (v if k in counts else v / accum)
                     for k, v in m_sum.items()
                 }
             else:

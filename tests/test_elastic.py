@@ -137,6 +137,41 @@ def test_grad_accum_matches_full_batch(mesh8):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+def test_grad_accum_uneven_mask_matches_full_batch(mesh8):
+    """ADVICE r2(c) regression: with mask density varying across microbatches,
+    accumulation must reproduce the GLOBAL token-weighted mean (loss-sum and
+    token-count accumulated, one divide at the end) — not the mean of
+    per-microbatch means."""
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (8, 33), dtype=np.int32)
+    mask = np.ones((8, 33), dtype=np.float32)
+    mask[4:, 8:] = 0.0   # microbatch 1 (rows 4-7) has 4x fewer live tokens
+    batch = put_batch(mesh8, {"tokens": jnp.asarray(tokens),
+                              "mask": jnp.asarray(mask)})
+
+    def mk(accum):
+        t = Trainer(
+            mesh=mesh8,
+            init_params_fn=lambda rng: llama.init_params(rng, cfg),
+            params_logical_axes=llama.param_logical_axes(cfg),
+            loss_fn=lm_loss_fn(llama.forward, cfg),
+            config=TrainerConfig(learning_rate=1e-3, warmup_steps=2,
+                                 total_steps=100, grad_accum=accum),
+        )
+        t.init_state(jax.random.key(0))
+        return t
+
+    t1, t2 = mk(1), mk(2)
+    m1, m2 = t1.train_step(batch), t2.train_step(batch)
+    assert float(m1["tokens"]) == float(m2["tokens"])
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(t1.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(t2.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
 def test_heartbeat_staleness_triggers_gang_restart(tmp_path):
     cluster = FakeCluster()
     ctl = JobController(cluster)

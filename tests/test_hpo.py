@@ -201,6 +201,9 @@ def test_failed_trials_bound():
     result = ctl.run(timeout=60.0)
     assert result.failed
     assert result.completion_reason == "MaxFailedTrialCountExceeded"
+    # ADVICE r1(b) regression: the budget is *reached* at exactly
+    # max_failed_trial_count failures (Katib semantics), not budget+1.
+    assert result.counts()[TrialState.FAILED] == 2
     runner.shutdown()
 
 

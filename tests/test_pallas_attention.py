@@ -110,6 +110,19 @@ def test_q_offset_rejected_for_kernel_impls():
         attention(q, k, v, causal=True, impl="pallas", q_offset=4)
 
 
+def test_q_offset_zero_explicit_ok():
+    """ADVICE r2(a) regression: explicitly passing the benign default
+    q_offset=0 with a kernel impl must not raise (the check runs unjitted,
+    so it sees the concrete int, not a Tracer)."""
+    from kubeflow_tpu.ops.attention import attention
+
+    q, k, v = _rand_qkv(jax.random.key(10), 1, 32, 4, 2, 32)
+    out = attention(q, k, v, causal=True, impl="pallas", q_offset=0,
+                    block_q=16, block_kv=16)
+    ref = attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
 def test_attention_dispatcher_pallas_impl():
     from kubeflow_tpu.ops.attention import attention
 

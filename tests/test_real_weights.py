@@ -241,7 +241,9 @@ def test_isvc_real_weights_text_e2e(tmp_path):
         assert pod.env["KFT_STORAGE_URI"].startswith("file://")
         cluster.start_pod(pod)                      # kubelet role
         url = "http://" + pod.env["KFT_BIND"]
-        deadline = time.time() + 120
+        # generous: the predictor subprocess pays a cold jax import + compile,
+        # and the full suite can run under heavy CPU contention
+        deadline = time.time() + 300
         ready = False
         # init step runs async: pod is Pending until storage materializes
         while time.time() < deadline and pod.phase == PodPhase.PENDING:
