@@ -25,22 +25,60 @@ def main(argv=None) -> int:
     serve.add_argument("--heartbeat-timeout", type=float, default=60.0)
     serve.add_argument("--reconcile-period", type=float, default=0.25)
     serve.add_argument("--log-dir", default="/tmp/kft-pods")
+    serve.add_argument("--state-dir", default="/tmp/kft-state",
+                       help="durable platform state (metadata WAL, HPO "
+                            "trial metrics)")
     args = parser.parse_args(argv)
 
     from kubeflow_tpu.controller.cluster import FakeCluster, LocalProcessCluster
     from kubeflow_tpu.controller.operator import Operator
     from kubeflow_tpu.controller.reconciler import JobController
+    from kubeflow_tpu.hpo.manager import ExperimentManager
+    from kubeflow_tpu.hpo.persistence import ExperimentStore
+    from kubeflow_tpu.metadata.store import MetadataStore
+    from kubeflow_tpu.serving.controller import (
+        Autoscaler, RuntimeRegistry, ServingController, ServingTicker,
+    )
 
     cluster = (LocalProcessCluster(log_dir=args.log_dir)
                if args.cluster == "local" else FakeCluster())
     controller = JobController(cluster)
+
+    # the whole platform in one daemon: training jobs + HPO experiments
+    # (durable via the metadata WAL — a restart resumes unfinished sweeps)
+    # + serving reconcile/autoscale
+    import os
+
+    os.makedirs(args.state_dir, exist_ok=True)
+    store = ExperimentStore(MetadataStore(
+        wal_path=os.path.join(args.state_dir, "metadata.wal")))
+    experiments = ExperimentManager(
+        controller, metrics_dir=os.path.join(args.state_dir, "trial-metrics"),
+        store=store)
+    resumed = experiments.resume_persisted()
+    # default runtimes so a POSTed InferenceService is servable out of the
+    # box: the first-party predictor entrypoint for llama/jax formats
+    from kubeflow_tpu.serving.types import ModelFormat, ServingRuntime
+
+    registry = RuntimeRegistry()
+    registry.register(ServingRuntime(
+        name="kft-runtime",
+        supported_formats=[ModelFormat("llama"), ModelFormat("jax")],
+        command=[sys.executable, "-m", "kubeflow_tpu.serving.runtime"]))
+    serving = ServingTicker(
+        ServingController(cluster, registry), Autoscaler())
+
     op = Operator(
         controller,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout_s=args.heartbeat_timeout,
         reconcile_period=args.reconcile_period,
+        experiment_manager=experiments,
+        serving_ticker=serving,
     )
     port = op.start(port=args.port)
+    if resumed:
+        print(f"kft-operator resumed experiments: {resumed}", flush=True)
     print(f"kft-operator serving on 127.0.0.1:{port}", flush=True)
 
     stop = threading.Event()

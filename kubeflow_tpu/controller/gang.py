@@ -1,17 +1,116 @@
-"""Gang scheduling: all-or-nothing admission of a job's pod group.
+"""Gang scheduling: all-or-nothing admission of a job's pod group onto
+whole TPU slices.
 
 The reference delegates this to volcano/scheduler-plugins PodGroups
 (SURVEY.md §2.1 'Gang-scheduling glue', §7 hard part #1: partial-slice
-deadlock is the failure mode). TPU slices make it stricter: a JAXJob's
-workers are the hosts of ONE slice — placing some of them is useless, so
-admission is atomic over slice capacity.
+deadlock is the failure mode). TPU slices make it stricter than generic
+gang scheduling: the atom of placement is a *slice* (a topology like
+"4x4" = 16 chips = 4 hosts), a slice belongs to at most one job, and a
+multi-host job is either one slice of sufficient shape or k identical
+whole slices (multislice over DCN). Placing part of a job — or two jobs
+on one slice — is useless, so admission reserves whole slices atomically
+or not at all.
+
+Starvation control: pure backfill (small jobs admitted past a blocked
+large one) would starve the large job forever under churn. A pending
+group older than ``aging_s`` becomes a head-of-line blocker for its
+pool: nothing younger is admitted from that pool until it fits.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from typing import Optional
+
+
+def topology_hosts(topology: str, chips_per_host: int = 4) -> int:
+    """Hosts in a slice topology string, e.g. "4x4" -> 16 chips -> 4 hosts."""
+    chips = math.prod(int(x) for x in topology.split("x"))
+    return max(1, chips // chips_per_host)
+
+
+@dataclasses.dataclass
+class TpuSlice:
+    """One physical TPU slice: the unit of allocation."""
+
+    id: str
+    topology: str = "2x2"             # chip grid; 4 chips = 1 host default
+    chips_per_host: int = 4
+    allocated_to: Optional[tuple[str, str]] = None   # (namespace, name)
+
+    @property
+    def hosts(self) -> int:
+        return topology_hosts(self.topology, self.chips_per_host)
+
+    @property
+    def free(self) -> bool:
+        return self.allocated_to is None
+
+
+@dataclasses.dataclass
+class SlicePool:
+    """The slices of one accelerator type (e.g. four v5p "2x2x4" slices).
+
+    Legacy host-count construction (``SlicePool(total_hosts=8)``) models the
+    capacity as single-host slices, preserving the old integer semantics.
+    """
+
+    accelerator: str = "any"
+    slices: Optional[list[TpuSlice]] = None
+    total_hosts: dataclasses.InitVar[Optional[int]] = None
+    free_hosts: dataclasses.InitVar[Optional[int]] = None   # legacy, ignored
+
+    def __post_init__(self, total_hosts, free_hosts):
+        if self.slices is None:
+            n = 64 if total_hosts is None else total_hosts
+            self.slices = [
+                TpuSlice(id=f"{self.accelerator}-{i}") for i in range(n)
+            ]
+
+    @property
+    def capacity_hosts(self) -> int:
+        return sum(s.hosts for s in self.slices)
+
+    @property
+    def available_hosts(self) -> int:
+        return sum(s.hosts for s in self.slices if s.free)
+
+    def find_allocation(self, n_hosts: int) -> Optional[list[TpuSlice]]:
+        """Whole slices for an n_hosts job, or None. Preference order:
+        (1) one exact-fit slice; (2) k identical slices with
+        k*hosts == n_hosts (multislice, fewest slices); (3) one larger
+        slice (whole-slice owned: the stranded hosts stay with the job,
+        never shared)."""
+        free = [s for s in self.slices if s.free]
+        single = sorted((s for s in free if s.hosts >= n_hosts),
+                        key=lambda s: s.hosts)
+        if single and single[0].hosts == n_hosts:
+            return [single[0]]
+        by_size: dict[int, list[TpuSlice]] = {}
+        for s in free:
+            by_size.setdefault(s.hosts, []).append(s)
+        for h in sorted(by_size, reverse=True):      # fewest slices first
+            if n_hosts % h == 0 and len(by_size[h]) >= n_hosts // h:
+                return by_size[h][: n_hosts // h]
+        if single:
+            return [single[0]]
+        return None
+
+    def allocate(self, n_hosts: int, key: tuple[str, str]
+                 ) -> Optional[list[TpuSlice]]:
+        chosen = self.find_allocation(n_hosts)
+        if chosen is None:
+            return None
+        for s in chosen:
+            s.allocated_to = key
+        return chosen
+
+    def release(self, key: tuple[str, str]) -> None:
+        for s in self.slices:
+            if s.allocated_to == key:
+                s.allocated_to = None
 
 
 @dataclasses.dataclass
@@ -25,45 +124,49 @@ class PodGroup:
     created_at: float = dataclasses.field(default_factory=time.time)
 
 
-@dataclasses.dataclass
-class SlicePool:
-    """Capacity of one TPU slice type (e.g. 16 hosts of v5p in 4 slices)."""
-
-    accelerator: str = "any"
-    total_hosts: int = 64
-    free_hosts: int = 64
-
-
 class GangScheduler:
-    """Priority/FIFO queue with atomic admission against host capacity.
+    """Priority/FIFO queue with atomic whole-slice admission.
 
-    Admission is all-or-nothing per PodGroup: either `min_member` hosts are
-    reserved atomically or the group stays queued — no partial placement, no
-    deadlock from two half-placed jobs holding each other's hosts.
+    Admission is all-or-nothing per PodGroup: either the slices covering
+    `min_member` hosts are reserved atomically or the group stays queued
+    holding NOTHING — no partial placement, no deadlock from two
+    half-placed jobs holding each other's hosts. Backfill past a blocked
+    group is allowed only until that group has waited ``aging_s``.
     """
 
-    def __init__(self, pools: Optional[dict[str, SlicePool]] = None):
+    def __init__(self, pools: Optional[dict[str, SlicePool]] = None,
+                 aging_s: float = 300.0):
         self.pools = pools or {"any": SlicePool()}
+        for name, pool in self.pools.items():
+            if pool.accelerator == "any" and name != "any":
+                pool.accelerator = name
+        self.aging_s = aging_s
         self.groups: dict[tuple[str, str], PodGroup] = {}
-        self.reservations: dict[tuple[str, str], tuple[str, int]] = {}
+        self.reservations: dict[tuple[str, str], tuple[str, list[str]]] = {}
 
     def add_group(self, group: PodGroup, accelerator: str = "any") -> None:
         key = (group.namespace, group.name)
         if key not in self.groups:
             self.groups[key] = group
-            self.reservations.setdefault(key, (accelerator, 0))
+            self.reservations.setdefault(key, (accelerator, []))
 
     def remove_group(self, namespace: str, name: str) -> None:
         key = (namespace, name)
-        group = self.groups.pop(key, None)
-        acc, held = self.reservations.pop(key, ("any", 0))
-        if group and held:
-            self.pools[acc].free_hosts += held
+        self.groups.pop(key, None)
+        acc, slice_ids = self.reservations.pop(key, ("any", []))
+        if slice_ids:
+            self._pool_for(acc).release(key)
 
-    def try_admit(self) -> list[PodGroup]:
+    def _pool_for(self, acc: str) -> Optional[SlicePool]:
+        return self.pools.get(acc) or self.pools.get("any")
+
+    def try_admit(self, now: Optional[float] = None) -> list[PodGroup]:
         """Admit queued groups in priority order (then FIFO). Returns newly
-        admitted groups."""
+        admitted groups. A group pending longer than ``aging_s`` blocks
+        backfill in its pool so churn cannot starve it."""
+        now = time.time() if now is None else now
         admitted = []
+        blocked_pools: set[int] = set()
         pending = sorted(
             (g for g in self.groups.values() if not g.admitted),
             key=lambda g: (-g.priority, g.created_at),
@@ -71,20 +174,37 @@ class GangScheduler:
         for group in pending:
             key = (group.namespace, group.name)
             acc, _ = self.reservations[key]
-            pool = self.pools.get(acc) or self.pools.get("any")
-            if pool is None:
+            pool = self._pool_for(acc)
+            if pool is None or id(pool) in blocked_pools:
                 continue
-            if pool.free_hosts >= group.min_member:
-                pool.free_hosts -= group.min_member
-                self.reservations[key] = (acc if acc in self.pools else "any",
-                                          group.min_member)
+            slices = pool.allocate(group.min_member, key)
+            if slices is not None:
+                self.reservations[key] = (
+                    acc if acc in self.pools else "any",
+                    [s.id for s in slices])
                 group.admitted = True
                 admitted.append(group)
-            # strict FIFO head-of-line within a pool would starve large jobs
-            # forever under churn; we keep scanning so smaller jobs backfill,
-            # but priority ordering ensures head jobs win ties.
+            elif now - group.created_at >= self.aging_s:
+                # aged head-of-line: stop backfilling this pool
+                blocked_pools.add(id(pool))
         return admitted
 
     def is_admitted(self, namespace: str, name: str) -> bool:
         g = self.groups.get((namespace, name))
         return bool(g and g.admitted)
+
+    def slice_ids(self, namespace: str, name: str) -> list[str]:
+        """Slice ids reserved for an admitted group (placement hints for
+        pod node selectors)."""
+        return list(self.reservations.get((namespace, name), ("any", []))[1])
+
+    def slice_allocation(self, namespace: str, name: str
+                         ) -> list[tuple[str, int]]:
+        """-> [(slice_id, hosts)] reserved for an admitted group, in
+        reservation order — the shape pod placement fills host by host."""
+        acc, ids = self.reservations.get((namespace, name), ("any", []))
+        pool = self._pool_for(acc)
+        if pool is None:
+            return []
+        by_id = {s.id: s.hosts for s in pool.slices}
+        return [(sid, by_id.get(sid, 1)) for sid in ids]

@@ -82,8 +82,23 @@ class Operator:
         heartbeat_period: float = 1.0,
         serving_tickers: tuple = (),
         serving_period: float = 1.0,
+        experiment_manager=None,
+        serving_ticker=None,
     ):
         self.controller = controller
+        # one daemon, every control loop (SURVEY.md §7 single-binary stance):
+        # the HPO experiment manager and the serving reconcile+autoscale
+        # ticker run on the serving period alongside any custom tickers
+        self.experiments = experiment_manager
+        self.serving = serving_ticker
+        serving_tickers = tuple(serving_tickers)
+        # both tickers mutate JobController/cluster state (trial jobs, pods),
+        # so they run under the same operator lock as reconcile/heartbeat
+        if experiment_manager is not None:
+            serving_tickers += (
+                lambda: self._locked(experiment_manager.tick),)
+        if serving_ticker is not None:
+            serving_tickers += (lambda: self._locked(serving_ticker.tick),)
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -123,6 +138,10 @@ class Operator:
             controller.pod_mutator = mutator
 
     # ---------------- job API (the apiserver role) ----------------
+
+    def _locked(self, fn):
+        with self._lock:
+            return fn()
 
     def submit(self, job) -> None:
         with self._lock:
@@ -245,6 +264,34 @@ class Operator:
             t.join(timeout=5)
 
 
+def _experiment_to_dict(exp) -> dict:
+    best = exp.best_trial
+    return {
+        "name": exp.name,
+        "namespace": exp.namespace,
+        "succeeded": exp.succeeded,
+        "failed": exp.failed,
+        "completion_reason": exp.completion_reason,
+        "trials": {s.value: n for s, n in exp.counts().items() if n},
+        "trials_total": len(exp.trials),
+        "best_trial": (
+            {"name": best.name, "parameters": best.parameters,
+             "objective_value": best.objective_value}
+            if best else None),
+    }
+
+
+def _isvc_to_dict(isvc) -> dict:
+    return {
+        "name": isvc.name,
+        "namespace": isvc.namespace,
+        "ready": isvc.status.ready,
+        "url": isvc.status.url,
+        "latest_revision": isvc.status.latest_revision,
+        "traffic": {str(k): v for k, v in isvc.status.traffic.items()},
+    }
+
+
 def _job_to_dict(job) -> dict:
     cond = job.status.condition()
     return {
@@ -280,13 +327,16 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
             self.end_headers()
             self.wfile.write(data)
 
-        def _job_path(self):
-            # /apis/v1/namespaces/{ns}/jobs[/{name}]
+        def _resource_path(self, kind: str):
+            # /apis/v1/namespaces/{ns}/{kind}[/{name}]
             parts = self.path.strip("/").split("/")
             if (len(parts) >= 4 and parts[0] == "apis" and parts[1] == "v1"
-                    and parts[2] == "namespaces" and parts[4:5] == ["jobs"]):
+                    and parts[2] == "namespaces" and parts[4:5] == [kind]):
                 return parts[3], (parts[5] if len(parts) > 5 else None)
             return None, None
+
+        def _job_path(self):
+            return self._resource_path("jobs")
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -303,27 +353,88 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 jobs = [_job_to_dict(j) for (jns, _), j in
                         op.controller.jobs.items() if jns == ns]
                 return self._send(200, json.dumps({"items": jobs}))
+            ns, name = self._resource_path("experiments")
+            if ns and op.experiments is not None:
+                if name:
+                    exp = op.experiments.get(ns, name)
+                    if exp is None:
+                        return self._send(404, '{"error": "not found"}')
+                    return self._send(200,
+                                      json.dumps(_experiment_to_dict(exp)))
+                return self._send(200, json.dumps({"items": [
+                    _experiment_to_dict(e) for e in op.experiments.list()
+                    if e.namespace == ns]}))
+            ns, name = self._resource_path("inferenceservices")
+            if ns and op.serving is not None:
+                ctl = op.serving.controller
+                if name:
+                    isvc = ctl.get(ns, name)
+                    if isvc is None:
+                        return self._send(404, '{"error": "not found"}')
+                    return self._send(200, json.dumps(_isvc_to_dict(isvc)))
+                return self._send(200, json.dumps({"items": [
+                    _isvc_to_dict(s) for (sns, _), s in ctl.services.items()
+                    if sns == ns]}))
             self._send(404, '{"error": "unknown path"}')
 
         def do_POST(self):
-            ns, _ = self._job_path()
-            if not ns:
-                return self._send(404, '{"error": "unknown path"}')
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length).decode()
-            try:
-                job = from_yaml(body)   # YAML superset: JSON bodies work too
-                job.namespace = job.namespace or ns
-                op.submit(job)
-            except Exception as e:
-                return self._send(400, json.dumps({"error": str(e)}))
-            self._send(201, json.dumps(_job_to_dict(job)))
+            ns, _ = self._job_path()
+            if ns:
+                try:
+                    job = from_yaml(body)   # YAML superset: JSON works too
+                    job.namespace = job.namespace or ns
+                    op.submit(job)
+                except Exception as e:
+                    return self._send(400, json.dumps({"error": str(e)}))
+                return self._send(201, json.dumps(_job_to_dict(job)))
+            ns, _ = self._resource_path("experiments")
+            if ns and op.experiments is not None:
+                try:
+                    from kubeflow_tpu.hpo.persistence import (
+                        experiment_from_dict,
+                    )
+
+                    payload = json.loads(body)
+                    exp = experiment_from_dict(payload["experiment"])
+                    exp.namespace = exp.namespace or ns
+                    op.experiments.submit(exp, payload["trial_template"])
+                except Exception as e:
+                    return self._send(400, json.dumps({"error": str(e)}))
+                return self._send(
+                    201, json.dumps(_experiment_to_dict(exp)))
+            ns, _ = self._resource_path("inferenceservices")
+            if ns and op.serving is not None:
+                try:
+                    from kubeflow_tpu.serving.types import (
+                        inference_service_from_dict,
+                    )
+
+                    payload = json.loads(body)
+                    payload.setdefault("namespace", ns)
+                    isvc = inference_service_from_dict(payload)
+                    with op._lock:
+                        op.serving.controller.apply(isvc)
+                except Exception as e:
+                    return self._send(400, json.dumps({"error": str(e)}))
+                return self._send(201, json.dumps(_isvc_to_dict(isvc)))
+            self._send(404, '{"error": "unknown path"}')
 
         def do_DELETE(self):
             ns, name = self._job_path()
-            if not (ns and name):
-                return self._send(404, '{"error": "unknown path"}')
-            op.delete(ns, name)
-            self._send(200, "{}")
+            if ns and name:
+                op.delete(ns, name)
+                return self._send(200, "{}")
+            ns, name = self._resource_path("experiments")
+            if ns and name and op.experiments is not None:
+                op.experiments.delete(ns, name)
+                return self._send(200, "{}")
+            ns, name = self._resource_path("inferenceservices")
+            if ns and name and op.serving is not None:
+                with op._lock:
+                    op.serving.controller.delete(ns, name)
+                return self._send(200, "{}")
+            self._send(404, '{"error": "unknown path"}')
 
     return ThreadingHTTPServer(("127.0.0.1", port), Handler)

@@ -175,7 +175,25 @@ class JobController:
         )
         if not admitted:
             return
-        for pod in self.cluster.list_pods(job.namespace, _job_selector(job)):
+        # placement hint: fill the reserved slices host by host with the
+        # TPU-bearing replicas in (type, index) order (the GKE nodeSelector
+        # role — each worker learns which physical slice it runs on).
+        # Replicas whose template requests no TPU (e.g. a coordinator) get
+        # no slice assignment.
+        alloc = self.scheduler.slice_allocation(job.namespace, job.name)
+        pods = self.cluster.list_pods(job.namespace, _job_selector(job))
+        if alloc:
+            tpu_types = {rt for rt, spec in job.replica_specs.items()
+                         if spec.template.tpu is not None} or set(
+                             job.replica_specs)
+            tpu_pods = sorted(
+                (p for p in pods if p.labels.get("replica-type") in tpu_types),
+                key=lambda p: (p.labels.get("replica-type", ""),
+                               int(p.labels.get("replica-index", 0))))
+            flat = [sid for sid, hosts in alloc for _ in range(hosts)]
+            for pod, sid in zip(tpu_pods, flat):
+                pod.env.setdefault("KFT_SLICE_ID", sid)
+        for pod in pods:
             if pod.phase == PodPhase.PENDING and not pod.scheduled:
                 pod.scheduled = True
                 if isinstance(self.cluster, LocalProcessCluster):

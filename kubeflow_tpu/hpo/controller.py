@@ -193,7 +193,8 @@ class ExperimentController:
     JobController.run_to_completion)."""
 
     def __init__(self, experiment: Experiment, runner: TrialRunner,
-                 core: Optional[SuggestionCore] = None):
+                 core: Optional[SuggestionCore] = None, store=None,
+                 trial_seq: int = 0):
         experiment.validate()
         self.exp = experiment
         self.runner = runner
@@ -201,10 +202,47 @@ class ExperimentController:
         self.core.register(experiment)
         self.stopper = make_stopper(experiment.objective,
                                     experiment.early_stopping)
-        self._trial_seq = 0
+        # trial_seq is passed on resume so the initial sync below never
+        # writes a zeroed cursor over the persisted one (a crash between
+        # resume and the first step must not recycle trial names)
+        self._trial_seq = trial_seq
+        # optional durability: hpo.persistence.ExperimentStore — status +
+        # changed trials written through after every reconcile pass
+        self.store = store
+        if store is not None:
+            store.sync(experiment, self._trial_seq)
+
+    @classmethod
+    def resume(cls, namespace: str, name: str, runner: TrialRunner, store,
+               core: Optional[SuggestionCore] = None) -> "ExperimentController":
+        """Reconstruct a controller from the metadata store after a daemon
+        restart. In-flight trials died with the previous process and are
+        marked KILLED (not FAILED: a crash of the *operator* must not eat
+        the experiment's failure budget). Cursor-based suggestion algorithms
+        (grid/sobol) are fast-forwarded past the consumed prefix; history-
+        conditioned ones (TPE/CMA-ES) re-fit from the restored trials."""
+        loaded = store.load(namespace, name)
+        if loaded is None:
+            raise KeyError(f"experiment {namespace}/{name} not in store")
+        exp, seq, _ = loaded
+        for t in exp.trials:
+            if not t.is_finished():
+                t.state = TrialState.KILLED
+                t.completion_time = time.time()
+        ctl = cls(exp, runner, core, store=store, trial_seq=seq)
+        if exp.trials and not (exp.succeeded or exp.failed):
+            # consume (and discard) as many suggestions as were previously
+            # issued so grid/sobol cursors do not replay duplicates
+            ctl.core.get_suggestions(exp.name, len(exp.trials))
+        return ctl
 
     # one reconcile pass ----------------------------------------------------
     def step(self) -> None:
+        self._step()
+        if self.store is not None:
+            self.store.sync(self.exp, self._trial_seq)
+
+    def _step(self) -> None:
         exp = self.exp
         if exp.succeeded or exp.failed:
             return

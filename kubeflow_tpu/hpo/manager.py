@@ -1,0 +1,126 @@
+"""Experiment manager: the HPO layer inside the operator daemon.
+
+The reference runs Katib as its own controller-manager Deployment next to
+the training operator; this package's single-binary stance (SURVEY.md §7)
+puts the experiment reconcile loop inside the SAME daemon process as the
+job controller — one more ticker on the operator's control loop. Durable
+state lives in the metadata store (hpo.persistence), so a daemon restart
+resumes every unfinished experiment from disk, Katib's resumePolicy:
+LongRunning behavior without a separate DB tier.
+
+Trial templates are JobSpec YAML with ``${param}`` placeholders — Katib's
+trialTemplate parameter substitution ([U] katib trialTemplate), rendered
+per trial and submitted through the shared JobController.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from kubeflow_tpu.api.types import JobSpec, from_yaml
+from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.hpo.controller import ExperimentController, JobTrialRunner
+from kubeflow_tpu.hpo.persistence import ExperimentStore
+from kubeflow_tpu.hpo.types import Experiment
+
+
+def render_trial_template(template_yaml: str) -> Callable[[str, dict], JobSpec]:
+    """trialTemplate substitution: every ``${name}`` in the YAML is replaced
+    with the assignment's value, then parsed into a JobSpec."""
+
+    def template(trial_name: str, params: dict) -> JobSpec:
+        text = template_yaml
+        for k, v in params.items():
+            text = text.replace("${" + k + "}", str(v))
+        job = from_yaml(text)
+        # numeric substitutions re-parse as YAML numbers; env is str->str
+        for spec in job.replica_specs.values():
+            spec.template.env = {
+                k: str(v) for k, v in spec.template.env.items()}
+        return job
+
+    return template
+
+
+class ExperimentManager:
+    """Owns the live ExperimentControllers of one daemon process."""
+
+    def __init__(self, jobs: JobController, metrics_dir: str,
+                 store: Optional[ExperimentStore] = None):
+        self.jobs = jobs
+        self.metrics_dir = metrics_dir
+        self.store = store
+        self.controllers: dict[tuple[str, str], ExperimentController] = {}
+        self._lock = threading.RLock()
+
+    def _runner(self, template_yaml: str) -> JobTrialRunner:
+        return JobTrialRunner(self.jobs, render_trial_template(template_yaml),
+                              self.metrics_dir)
+
+    def submit(self, exp: Experiment, trial_template: str
+               ) -> ExperimentController:
+        with self._lock:
+            key = (exp.namespace, exp.name)
+            if key in self.controllers:
+                raise ValueError(f"experiment {key} already exists")
+            if self.store is not None:
+                # spec + template recorded BEFORE the first reconcile so a
+                # crash at any later point can reconstruct the controller
+                self.store.create_experiment(
+                    exp, extra_props={"trial_template": trial_template})
+            ctl = ExperimentController(exp, self._runner(trial_template),
+                                       store=self.store)
+            self.controllers[key] = ctl
+            return ctl
+
+    def resume_persisted(self) -> list[tuple[str, str]]:
+        """Reconstruct controllers for every unfinished stored experiment
+        (daemon-restart path). Returns resumed (namespace, name) keys."""
+        if self.store is None:
+            return []
+        resumed = []
+        with self._lock:
+            for ns, name in self.store.list_experiments():
+                key = (ns, name)
+                if key in self.controllers:
+                    continue
+                loaded = self.store.load(ns, name)
+                if loaded is None:
+                    continue
+                exp, _, props = loaded
+                if exp.succeeded or exp.failed:
+                    continue
+                template = props.get("trial_template")
+                if not template:
+                    continue
+                self.controllers[key] = ExperimentController.resume(
+                    ns, name, self._runner(template), self.store)
+                resumed.append(key)
+        return resumed
+
+    def tick(self) -> None:
+        """One reconcile pass over every live experiment (operator ticker)."""
+        with self._lock:
+            ctls = list(self.controllers.values())
+        for ctl in ctls:
+            if not (ctl.exp.succeeded or ctl.exp.failed):
+                ctl.step()
+
+    def get(self, namespace: str, name: str) -> Optional[Experiment]:
+        with self._lock:
+            ctl = self.controllers.get((namespace, name))
+            return ctl.exp if ctl else None
+
+    def list(self) -> list[Experiment]:
+        with self._lock:
+            return [c.exp for c in self.controllers.values()]
+
+    def delete(self, namespace: str, name: str) -> None:
+        with self._lock:
+            ctl = self.controllers.pop((namespace, name), None)
+        if ctl is not None:
+            ctl._kill_running()
+        if self.store is not None:
+            # tombstone: a restart must not resurrect a deleted experiment
+            self.store.mark_deleted(namespace, name)

@@ -1,0 +1,234 @@
+"""Durable HPO: experiments/trials/observations in the metadata store.
+
+The reference persists Katib state in MySQL behind katib-db-manager
+(SURVEY.md §2.3 'DB-manager persistence', [U] katib:pkg/db/v1beta1/). Here
+the SAME lineage store that backs pipelines is the database — an experiment
+is a Context, each trial is an Execution associated with it, and the
+experiment's live status rides a dedicated status Execution (contexts are
+immutable in MLMD-style stores; executions are updatable). Works against
+either backend: the in-proc ``MetadataStore`` (WAL-replayed on restart) or
+the native C++ server via ``MetadataClient``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+from kubeflow_tpu.hpo.types import (
+    AlgorithmSpec, EarlyStoppingSpec, Experiment, ObjectiveSpec, Observation,
+    ParameterSpec, ParameterType, ObjectiveGoalType, ResumePolicy, Trial,
+    TrialState,
+)
+
+EXPERIMENT_TYPE = "hpo_experiment"
+STATUS_TYPE = "hpo_experiment_status"
+TRIAL_TYPE = "hpo_trial"
+
+
+# --------------------------------------------------------------- serialization
+
+def experiment_spec_to_dict(exp: Experiment) -> dict:
+    return {
+        "name": exp.name,
+        "namespace": exp.namespace,
+        "parameters": [dataclasses.asdict(p) for p in exp.parameters],
+        "objective": dataclasses.asdict(exp.objective),
+        "algorithm": dataclasses.asdict(exp.algorithm),
+        "early_stopping": (dataclasses.asdict(exp.early_stopping)
+                           if exp.early_stopping else None),
+        "parallel_trial_count": exp.parallel_trial_count,
+        "max_trial_count": exp.max_trial_count,
+        "max_failed_trial_count": exp.max_failed_trial_count,
+        "resume_policy": exp.resume_policy.value,
+    }
+
+
+def experiment_from_dict(d: dict) -> Experiment:
+    params = []
+    for p in d["parameters"]:
+        p = dict(p)
+        p["type"] = ParameterType(p["type"])
+        params.append(ParameterSpec(**p))
+    obj = dict(d["objective"])
+    obj["goal_type"] = ObjectiveGoalType(obj["goal_type"])
+    es = None
+    if d.get("early_stopping"):
+        es = EarlyStoppingSpec(**d["early_stopping"])
+    return Experiment(
+        name=d["name"], namespace=d.get("namespace", "default"),
+        parameters=params, objective=ObjectiveSpec(**obj),
+        algorithm=AlgorithmSpec(**d["algorithm"]), early_stopping=es,
+        parallel_trial_count=d["parallel_trial_count"],
+        max_trial_count=d["max_trial_count"],
+        max_failed_trial_count=d["max_failed_trial_count"],
+        resume_policy=ResumePolicy(d.get("resume_policy", "Never")),
+    )
+
+
+def _trial_props(trial: Trial) -> dict:
+    return {
+        "parameters": json.dumps(trial.parameters),
+        "objective_value": json.dumps(trial.objective_value),
+        "observations": json.dumps([
+            [o.metric_name, o.value, o.step, o.timestamp]
+            for o in trial.observations
+        ]),
+        "start_time": trial.start_time,
+        "completion_time": json.dumps(trial.completion_time),
+    }
+
+
+def _trial_from_execution(name: str, ex) -> Trial:
+    p = ex.properties
+    t = Trial(
+        name=name,
+        parameters=json.loads(p.get("parameters", "{}")),
+        state=TrialState(ex.state),
+        objective_value=json.loads(str(p.get("objective_value", "null"))),
+        start_time=float(p.get("start_time", 0.0)),
+        completion_time=json.loads(str(p.get("completion_time", "null"))),
+    )
+    t.observations = [
+        Observation(metric_name=m, value=v, step=s, timestamp=ts)
+        for m, v, s, ts in json.loads(p.get("observations", "[]"))
+    ]
+    return t
+
+
+# --------------------------------------------------------------------- store
+
+class ExperimentStore:
+    """Write-through persistence for experiments over a metadata backend
+    (``metadata.store.MetadataStore`` or ``metadata.client.MetadataClient``
+    — same duck-typed surface). Records are keyed by
+    ``{namespace}/{name}`` so experiments are namespace-scoped like every
+    other resource."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self._ctx_ids: dict[str, int] = {}
+        self._status_ids: dict[str, int] = {}
+        self._trial_ids: dict[tuple[str, str], int] = {}
+        # change cache: trial -> (state, n_observations, objective_value)
+        self._trial_sig: dict[tuple[str, str], tuple] = {}
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    # -- experiment ---------------------------------------------------------
+
+    def create_experiment(self, exp: Experiment,
+                          extra_props: Optional[dict] = None) -> int:
+        """Record the (immutable) spec + a mutable status execution."""
+        key = self._key(exp.namespace, exp.name)
+        props = {"spec": json.dumps(experiment_spec_to_dict(exp))}
+        props.update(extra_props or {})
+        cid = self.backend.put_context(EXPERIMENT_TYPE, key, properties=props)
+        self._ctx_ids[key] = cid
+        sid = self._status_execution(key, cid)
+        self.backend.update_execution(
+            sid, state="RUNNING",
+            properties={"trial_seq": 0, "completion_reason": ""})
+        return cid
+
+    def _status_execution(self, key: str, cid: int) -> int:
+        if key not in self._status_ids:
+            ctx_execs = self.backend.executions_in_context(cid)
+            for ex in ctx_execs:
+                if ex.type == STATUS_TYPE:
+                    self._status_ids[key] = ex.id
+                    break
+            else:
+                sid = self.backend.put_execution(
+                    STATUS_TYPE, name=f"{key}/status", state="RUNNING")
+                self.backend.associate(cid, sid)
+                self._status_ids[key] = sid
+        return self._status_ids[key]
+
+    def sync(self, exp: Experiment, trial_seq: int) -> None:
+        """Persist status + any trial whose state/observations changed."""
+        ekey = self._key(exp.namespace, exp.name)
+        cid = self._ctx_ids.get(ekey)
+        if cid is None:
+            cid = self.create_experiment(exp)
+        for trial in exp.trials:
+            key = (ekey, trial.name)
+            sig = (trial.state.value, len(trial.observations),
+                   trial.objective_value)
+            if self._trial_sig.get(key) == sig:
+                continue
+            tid = self._trial_ids.get(key)
+            if tid is None:
+                tid = self.backend.put_execution(
+                    TRIAL_TYPE, name=f"{ekey}/{trial.name}",
+                    state=trial.state.value, properties=_trial_props(trial))
+                self.backend.associate(cid, tid)
+                self._trial_ids[key] = tid
+            else:
+                self.backend.update_execution(
+                    tid, state=trial.state.value,
+                    properties=_trial_props(trial))
+            self._trial_sig[key] = sig
+        state = ("SUCCEEDED" if exp.succeeded
+                 else "FAILED" if exp.failed else "RUNNING")
+        self.backend.update_execution(
+            self._status_execution(ekey, cid), state=state,
+            properties={"trial_seq": trial_seq,
+                        "completion_reason": exp.completion_reason})
+
+    def mark_deleted(self, namespace: str, name: str) -> None:
+        """Tombstone an experiment so a daemon restart never resumes it."""
+        key = self._key(namespace, name)
+        ctx = self.backend.context_by_name(EXPERIMENT_TYPE, key)
+        if ctx is None:
+            return
+        self.backend.update_execution(
+            self._status_execution(key, ctx.id), state="DELETED",
+            properties={"completion_reason": "Deleted"})
+
+    # -- load / resume ------------------------------------------------------
+
+    def list_experiments(self) -> list[tuple[str, str]]:
+        """-> [(namespace, name)]. Uses the in-proc backend's context table;
+        remote callers track names via the operator registry."""
+        contexts = getattr(self.backend, "contexts", None)
+        if contexts is None:
+            return []
+        return [tuple(c.name.split("/", 1)) for c in contexts.values()
+                if c.type == EXPERIMENT_TYPE and "/" in c.name]
+
+    def load(self, namespace: str, name: str
+             ) -> Optional[tuple[Experiment, int, dict]]:
+        """-> (experiment with trials + status, trial_seq, context_props).
+        A DELETED tombstone loads with failed=True/reason 'Deleted' so no
+        caller resumes it."""
+        ekey = self._key(namespace, name)
+        ctx = self.backend.context_by_name(EXPERIMENT_TYPE, ekey)
+        if ctx is None:
+            return None
+        exp = experiment_from_dict(json.loads(ctx.properties["spec"]))
+        self._ctx_ids[ekey] = ctx.id
+        trial_seq = 0
+        prefix = f"{ekey}/"
+        for ex in self.backend.executions_in_context(ctx.id):
+            if ex.type == STATUS_TYPE:
+                self._status_ids[ekey] = ex.id
+                trial_seq = int(ex.properties.get("trial_seq", 0))
+                exp.succeeded = ex.state == "SUCCEEDED"
+                exp.failed = ex.state in ("FAILED", "DELETED")
+                exp.completion_reason = ex.properties.get(
+                    "completion_reason", "")
+            elif ex.type == TRIAL_TYPE and ex.name.startswith(prefix):
+                tname = ex.name[len(prefix):]
+                trial = _trial_from_execution(tname, ex)
+                exp.trials.append(trial)
+                key = (ekey, trial.name)
+                self._trial_ids[key] = ex.id
+                self._trial_sig[key] = (
+                    trial.state.value, len(trial.observations),
+                    trial.objective_value)
+        exp.trials.sort(key=lambda t: t.start_time)
+        return exp, trial_seq, dict(ctx.properties)

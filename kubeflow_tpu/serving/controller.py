@@ -66,6 +66,8 @@ class ServingController:
         self.runtimes = runtimes
         self.services: dict[tuple[str, str], InferenceService] = {}
         self._applied_generation: dict[tuple[str, str], int] = {}
+        # autoscaler-applied predictor replica counts (absent => min_replicas)
+        self._desired: dict[tuple[str, str], int] = {}
 
     # -------------- apiserver-ish surface --------------
 
@@ -103,6 +105,10 @@ class ServingController:
 
     def delete(self, namespace: str, name: str) -> None:
         isvc = self.services.pop((namespace, name), None)
+        # a later re-created service with the same name starts from its own
+        # spec, not this one's autoscale state or revision cursor
+        self._desired.pop((namespace, name), None)
+        self._applied_generation.pop((namespace, name), None)
         if isvc is None:
             return
         for pod in self._pods(isvc):
@@ -144,6 +150,14 @@ class ServingController:
         for pod in self._pods(isvc, revision=latest):
             if pod.phase == PodPhase.FAILED:
                 self.cluster.delete_pod(isvc.namespace, pod.name)
+        # scale-down: drop excess predictor pods highest-index-first
+        want = self._predictor_replicas(isvc)
+        predictors = sorted(
+            (p for p in self._pods(isvc, revision=latest)
+             if p.labels.get("component") == "predictor"),
+            key=lambda p: int(p.name.rsplit("-", 1)[-1]))
+        for pod in predictors[want:]:
+            self.cluster.delete_pod(isvc.namespace, pod.name)
         self._create_revision_pods(isvc, runtime, latest)
         if self._revision_ready(isvc, latest):
             prev = isvc.status.ready_revision
@@ -160,6 +174,25 @@ class ServingController:
             # latest not ready yet: all traffic stays on the ready revision
             isvc.status.traffic = {isvc.status.ready_revision: 100}
         return isvc
+
+    def set_scale(self, namespace: str, name: str, replicas: int) -> None:
+        """Apply an autoscaler decision: the latest revision's predictor pod
+        count converges to ``replicas`` on subsequent reconciles (excess pods
+        deleted highest-index-first; missing ones recreated)."""
+        key = (namespace, name)
+        if key not in self.services:
+            return
+        self._desired[key] = max(0, int(replicas))
+        self.reconcile(namespace, name)
+
+    def tick_all(self) -> None:
+        """One reconcile pass over every InferenceService (daemon loop)."""
+        for (ns, name) in list(self.services.keys()):
+            self.reconcile(ns, name)
+
+    def _predictor_replicas(self, isvc: InferenceService) -> int:
+        return self._desired.get((isvc.namespace, isvc.name),
+                                 isvc.predictor.min_replicas)
 
     def promote(self, namespace: str, name: str) -> None:
         """Finish a canary rollout: 100% to latest, GC the old revision."""
@@ -212,7 +245,7 @@ class ServingController:
         init_cmd = ([sys.executable, "-m", "kubeflow_tpu.serving.runtime",
                      "--init-only"] if isvc.predictor.storage_uri else [])
         components: list[tuple[str, int, dict, list]] = [
-            ("predictor", isvc.predictor.min_replicas, predictor_env,
+            ("predictor", self._predictor_replicas(isvc), predictor_env,
              init_cmd),
         ]
         if isvc.transformer:
@@ -247,7 +280,7 @@ class ServingController:
 
     def _revision_ready(self, isvc: InferenceService, revision: int) -> bool:
         pods = self._pods(isvc, revision)
-        want = isvc.predictor.min_replicas
+        want = self._predictor_replicas(isvc)
         if isvc.transformer:
             want += isvc.transformer.min_replicas
         if isvc.explainer:
@@ -259,6 +292,51 @@ class ServingController:
         for pod in self._pods(isvc):
             if pod.labels.get("revision") != str(keep):
                 self.cluster.delete_pod(isvc.namespace, pod.name)
+
+
+class ServingTicker:
+    """Daemon glue for the serving layer: one ``tick()`` reconciles every
+    InferenceService and applies the autoscaler from a concurrency source.
+
+    The default source scrapes ``kft_requests_in_flight`` from each ready
+    predictor pod's /metrics (the KPA-scrape role); tests inject a callable.
+    """
+
+    def __init__(self, controller: ServingController,
+                 autoscaler: Optional["Autoscaler"] = None,
+                 concurrency_of=None):
+        self.controller = controller
+        self.autoscaler = autoscaler
+        self.concurrency_of = concurrency_of or self._probe_concurrency
+
+    def _probe_concurrency(self, isvc: InferenceService) -> float:
+        import urllib.request
+        total = 0.0
+        for pod in self.controller._pods(
+                isvc, revision=isvc.status.latest_revision):
+            bind = pod.env.get("KFT_BIND")
+            if not bind or pod.phase != PodPhase.RUNNING:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://{bind}/metrics", timeout=1.0) as r:
+                    for line in r.read().decode().splitlines():
+                        if line.startswith("kft_requests_in_flight "):
+                            total += float(line.split()[1])
+            except Exception:
+                continue
+        return total
+
+    def tick(self) -> None:
+        for (ns, name) in list(self.controller.services.keys()):
+            isvc = self.controller.reconcile(ns, name)
+            if self.autoscaler is None or isvc is None:
+                continue
+            if not isvc.status.ready:
+                continue
+            desired = self.autoscaler.scale(isvc, self.concurrency_of(isvc))
+            if desired != self.controller._predictor_replicas(isvc):
+                self.controller.set_scale(ns, name, desired)
 
 
 class Autoscaler:

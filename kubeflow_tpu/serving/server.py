@@ -40,6 +40,9 @@ class ModelServer:
         self.repository = repository or ModelRepository()
         self.request_count = 0
         self.error_count = 0
+        # concurrency gauge: the autoscaler's scale signal (KPA role)
+        self.in_flight = 0
+        self._gauge_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -63,10 +66,15 @@ class ModelServer:
 
             def do_GET(self):
                 outer.request_count += 1
+                with outer._gauge_lock:
+                    outer.in_flight += 1
                 try:
                     self._get()
                 except BrokenPipeError:
                     pass
+                finally:
+                    with outer._gauge_lock:
+                        outer.in_flight -= 1
 
             def _get(self):
                 path = self.path
@@ -90,6 +98,8 @@ class ModelServer:
                     text = (
                         f"kft_requests_total {outer.request_count}\n"
                         f"kft_request_errors_total {outer.error_count}\n"
+                        # minus this scrape itself
+                        f"kft_requests_in_flight {max(0, outer.in_flight - 1)}\n"
                     )
                     body = text.encode()
                     self.send_response(200)
@@ -114,10 +124,15 @@ class ModelServer:
 
             def do_POST(self):
                 outer.request_count += 1
+                with outer._gauge_lock:
+                    outer.in_flight += 1
                 try:
                     self._post()
                 except BrokenPipeError:
                     pass
+                finally:
+                    with outer._gauge_lock:
+                        outer.in_flight -= 1
 
             def _post(self):
                 path = self.path

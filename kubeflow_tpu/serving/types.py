@@ -97,6 +97,25 @@ class InferenceService:
     generation: int = 0           # bumped on every spec change
 
 
+def inference_service_from_dict(d: dict) -> InferenceService:
+    """JSON -> InferenceService (the operator's POST body; the apiserver
+    deserialization role). Only the predictor surface — transformer/explainer
+    specs are applied programmatically."""
+    p = dict(d.get("predictor", {}))
+    fmt = p.pop("model_format", "jax")
+    if isinstance(fmt, dict):
+        fmt = ModelFormat(**fmt)
+    else:
+        fmt = ModelFormat(str(fmt))
+    tpu = p.pop("tpu", None)
+    if isinstance(tpu, dict):
+        tpu = TPUSpec(**tpu)
+    predictor = PredictorSpec(model_format=fmt, tpu=tpu, **p)
+    return InferenceService(
+        name=d["name"], namespace=d.get("namespace", "default"),
+        labels=dict(d.get("labels", {})), predictor=predictor)
+
+
 # ---------------------------------------------------------------- graph ----
 
 class GraphNodeType(str, enum.Enum):

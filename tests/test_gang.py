@@ -1,0 +1,144 @@
+"""Slice-shaped gang scheduling tests (SURVEY.md §7 hard part #1: the
+partial-slice deadlock, plus backfill starvation/aging)."""
+
+import time
+
+from kubeflow_tpu.controller import GangScheduler, PodGroup, SlicePool
+from kubeflow_tpu.controller.gang import TpuSlice, topology_hosts
+
+
+def _pool(*topos, acc="v5e"):
+    return SlicePool(accelerator=acc, slices=[
+        TpuSlice(id=f"{acc}-{i}", topology=t) for i, t in enumerate(topos)
+    ])
+
+
+def _group(name, n, prio=0, created=None):
+    g = PodGroup(name=name, namespace="default", min_member=n, priority=prio)
+    if created is not None:
+        g.created_at = created
+    return g
+
+
+def test_topology_hosts():
+    assert topology_hosts("4x4") == 4          # 16 chips / 4 per host
+    assert topology_hosts("2x2") == 1
+    assert topology_hosts("2x2x4", chips_per_host=4) == 4
+    assert topology_hosts("4x8") == 8
+
+
+def test_whole_slice_deadlock_free():
+    """Two jobs each needing a full slice, capacity for one: one runs, one
+    queues, and the queued one holds NOTHING (no partial-slice deadlock)."""
+    sched = GangScheduler({"v5e": _pool("4x4")})
+    sched.add_group(_group("a", 4, created=1.0), accelerator="v5e")
+    sched.add_group(_group("b", 4, created=2.0), accelerator="v5e")
+    admitted = sched.try_admit(now=3.0)
+    assert [g.name for g in admitted] == ["a"]
+    assert not sched.is_admitted("default", "b")
+    # the queued group reserves zero slices — capacity is never half-held
+    assert sched.slice_ids("default", "b") == []
+    assert sched.pools["v5e"].available_hosts == 0
+    sched.remove_group("default", "a")
+    assert sched.pools["v5e"].available_hosts == 4
+    assert [g.name for g in sched.try_admit(now=4.0)] == ["b"]
+
+
+def test_partial_slice_placement_rejected():
+    """A slice belongs to one job: a 2-host job owns the whole 4-host slice
+    and a second 2-host job queues rather than sharing the remainder."""
+    sched = GangScheduler({"v5e": _pool("4x4")})
+    sched.add_group(_group("a", 2, created=1.0), accelerator="v5e")
+    sched.add_group(_group("b", 2, created=2.0), accelerator="v5e")
+    admitted = sched.try_admit(now=3.0)
+    assert [g.name for g in admitted] == ["a"]
+    assert len(sched.slice_ids("default", "a")) == 1
+    assert not sched.is_admitted("default", "b")
+
+
+def test_exact_fit_preferred_over_larger_slice():
+    pool = _pool("4x4", "4x8")                  # 4-host and 8-host slices
+    sched = GangScheduler({"v5e": pool})
+    sched.add_group(_group("a", 4), accelerator="v5e")
+    sched.try_admit()
+    (sid,) = sched.slice_ids("default", "a")
+    assert pool.slices[0].id == sid and pool.slices[0].hosts == 4
+
+
+def test_multislice_allocation_identical_slices():
+    """An 8-host job on 4-host slices takes exactly two whole slices."""
+    sched = GangScheduler({"v5e": _pool("4x4", "4x4", "4x4")})
+    sched.add_group(_group("big", 8), accelerator="v5e")
+    assert [g.name for g in sched.try_admit()] == ["big"]
+    assert len(sched.slice_ids("default", "big")) == 2
+    assert sched.pools["v5e"].available_hosts == 4
+
+
+def test_backfill_allowed_before_aging():
+    """Younger small jobs backfill past a blocked large job while it is
+    young (throughput), ..."""
+    sched = GangScheduler({"v5e": _pool("2x2", "2x2")}, aging_s=1e9)
+    sched.add_group(_group("big", 4, created=1.0), accelerator="v5e")
+    sched.add_group(_group("small", 1, created=2.0), accelerator="v5e")
+    admitted = sched.try_admit(now=3.0)
+    assert [g.name for g in admitted] == ["small"]
+
+
+def test_aged_large_job_blocks_backfill_and_admits():
+    """... but once the large job has waited past aging_s, backfill stops
+    and freed capacity accumulates until it fits (no starvation)."""
+    sched = GangScheduler(
+        {"v5e": _pool("2x2", "2x2", "2x2", "2x2")}, aging_s=10.0)
+    # two running small jobs occupy half the pool
+    sched.add_group(_group("s1", 1, created=0.0), accelerator="v5e")
+    sched.add_group(_group("s2", 1, created=0.0), accelerator="v5e")
+    sched.try_admit(now=0.0)
+    sched.add_group(_group("big", 4, created=1.0), accelerator="v5e")
+    # churn: a younger small job arrives; big has aged past aging_s
+    sched.add_group(_group("s3", 1, created=50.0), accelerator="v5e")
+    admitted = sched.try_admit(now=60.0)
+    assert admitted == []                       # backfill blocked by big
+    assert not sched.is_admitted("default", "s3")
+    sched.remove_group("default", "s1")
+    sched.remove_group("default", "s2")
+    admitted = sched.try_admit(now=61.0)
+    assert [g.name for g in admitted] == ["big"]
+    sched.remove_group("default", "big")
+    assert [g.name for g in sched.try_admit(now=62.0)] == ["s3"]
+
+
+def test_priority_beats_fifo():
+    sched = GangScheduler({"v5e": _pool("4x4")})
+    sched.add_group(_group("lo", 4, prio=0, created=1.0), accelerator="v5e")
+    sched.add_group(_group("hi", 4, prio=10, created=2.0), accelerator="v5e")
+    assert [g.name for g in sched.try_admit(now=3.0)] == ["hi"]
+
+
+def test_legacy_host_count_pool():
+    """SlicePool(total_hosts=N) still works: N single-host slices."""
+    pool = SlicePool(total_hosts=8, free_hosts=8)
+    assert pool.capacity_hosts == 8
+    sched = GangScheduler({"any": pool})
+    sched.add_group(_group("j", 5))
+    assert [g.name for g in sched.try_admit()] == ["j"]
+    assert pool.available_hosts == 3
+
+
+def test_slice_id_placement_hint_reaches_pods():
+    """Admitted workers learn their physical slice via KFT_SLICE_ID, spread
+    over the reserved slices in contiguous replica-index blocks."""
+    from kubeflow_tpu.api.types import TPUSpec, jax_job
+    from kubeflow_tpu.controller import FakeCluster, JobController
+
+    sched = GangScheduler({"v5e": _pool("4x4", "4x4")})
+    cluster = FakeCluster()
+    ctl = JobController(cluster, sched)
+    job = jax_job("pp", workers=8, tpu=TPUSpec("v5e", "4x4"),
+                  mesh={"data": 8})
+    ctl.submit(job)
+    ctl.reconcile("default", "pp")
+    pods = sorted(cluster.list_pods("default", {"job-name": "pp"}),
+                  key=lambda p: int(p.labels["replica-index"]))
+    ids = [p.env.get("KFT_SLICE_ID") for p in pods]
+    assert ids[0] is not None
+    assert ids == [ids[0]] * 4 + [ids[4]] * 4 and ids[0] != ids[4]

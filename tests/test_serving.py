@@ -231,6 +231,42 @@ def test_autoscaler():
     assert sc.scale(isvc0, 0, now=20.0) == 0    # scale to zero
 
 
+def test_serving_ticker_applies_autoscale():
+    """Daemon path: ServingTicker reconciles + applies Autoscaler decisions
+    to actual predictor pod counts (scale up on load, back down when idle,
+    scale-to-zero honored)."""
+    from kubeflow_tpu.serving.controller import ServingTicker
+
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(_runtime())
+    ctl = ServingController(cluster, reg)
+    load = {"c": 0.0}
+    ticker = ServingTicker(ctl, Autoscaler(idle_grace_seconds=0.0),
+                           concurrency_of=lambda isvc: load["c"])
+    ctl.apply(InferenceService(
+        name="m", predictor=PredictorSpec(min_replicas=1, max_replicas=4,
+                                          scale_target=4)))
+    _ready_all(cluster)
+    ticker.tick()
+    assert ctl.get("default", "m").status.ready
+
+    def predictor_pods():
+        return [p for p in cluster.pods.values()
+                if p.labels.get("component") == "predictor"]
+
+    assert len(predictor_pods()) == 1
+    load["c"] = 14.0                       # ceil(14/4) = 4 replicas
+    ticker.tick()
+    _ready_all(cluster)
+    ticker.tick()
+    assert len(predictor_pods()) == 4
+    load["c"] = 0.0
+    ticker.tick()
+    ticker.tick()
+    assert len(predictor_pods()) == 1      # back to min_replicas
+
+
 # ---------------------------------------------------------------- graph
 
 def _req(vals):
