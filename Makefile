@@ -1,0 +1,38 @@
+# One-command CI for the whole framework (SURVEY.md §5 sanitizers row).
+#
+#   make ci          - sanitized C++ store tests, full pytest, multichip dryrun
+#   make test        - pytest only
+#   make native-asan - build the metadata store with ASan+UBSan
+#   make dryrun      - 8-virtual-device sharded-training compile+execute check
+
+PY ?= python
+ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
+             -fsanitize=address,undefined -fno-omit-frame-pointer
+
+.PHONY: ci test native native-asan test-native-asan dryrun clean
+
+ci: test-native-asan test dryrun
+	@echo "CI OK"
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C native/metadata_store
+
+native-asan:
+	$(MAKE) -C native/metadata_store clean
+	$(MAKE) -C native/metadata_store CXXFLAGS="$(ASAN_FLAGS)"
+
+# run the metadata tests against the sanitized binary, then drop it so later
+# builds rebuild the optimized one (build_native() rebuilds on mtime)
+test-native-asan: native-asan
+	$(PY) -m pytest tests/test_metadata.py -x -q
+	$(MAKE) -C native/metadata_store clean
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		$(PY) __graft_entry__.py dryrun 8
+
+clean:
+	$(MAKE) -C native/metadata_store clean

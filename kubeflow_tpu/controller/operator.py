@@ -86,19 +86,27 @@ class Operator:
         serving_ticker=None,
     ):
         self.controller = controller
+        # One lock serializes every compound mutation of controller state
+        # (submit / delete / reconcile / heartbeat sweep / tickers): the
+        # loops and the HTTP threads otherwise interleave read-modify-write
+        # sequences. Contention is negligible at these loop periods.
+        self._lock = threading.RLock()
         # one daemon, every control loop (SURVEY.md §7 single-binary stance):
         # the HPO experiment manager and the serving reconcile+autoscale
         # ticker run on the serving period alongside any custom tickers
         self.experiments = experiment_manager
         self.serving = serving_ticker
         serving_tickers = tuple(serving_tickers)
-        # both tickers mutate JobController/cluster state (trial jobs, pods),
-        # so they run under the same operator lock as reconcile/heartbeat
+        # the experiment ticker mutates JobController/cluster state (trial
+        # jobs, pods), so it runs under the operator lock; the serving
+        # ticker takes the SAME lock internally but only around mutations —
+        # its concurrency probe does blocking HTTP and must not hold it
         if experiment_manager is not None:
             serving_tickers += (
                 lambda: self._locked(experiment_manager.tick),)
         if serving_ticker is not None:
-            serving_tickers += (lambda: self._locked(serving_ticker.tick),)
+            serving_ticker.lock = self._lock
+            serving_tickers += (serving_ticker.tick,)
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -112,11 +120,6 @@ class Operator:
         self.serving_period = serving_period
         self._submit_times: dict[tuple[str, str], float] = {}
         self._first_step_seen: set[tuple[str, str]] = set()
-        # One lock serializes every compound mutation of controller state
-        # (submit / delete / reconcile / heartbeat sweep): the reconcile,
-        # heartbeat, and HTTP threads otherwise interleave read-modify-write
-        # sequences. Contention is negligible at these loop periods.
-        self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -384,7 +387,13 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
             if ns:
                 try:
                     job = from_yaml(body)   # YAML superset: JSON works too
-                    job.namespace = job.namespace or ns
+                    # URL namespace wins (k8s convention); an explicit body
+                    # namespace that disagrees is a client error
+                    if job.namespace not in ("", "default", ns):
+                        raise ValueError(
+                            f"body namespace {job.namespace!r} != URL "
+                            f"namespace {ns!r}")
+                    job.namespace = ns
                     op.submit(job)
                 except Exception as e:
                     return self._send(400, json.dumps({"error": str(e)}))
@@ -397,9 +406,15 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
                     )
 
                     payload = json.loads(body)
-                    exp = experiment_from_dict(payload["experiment"])
-                    exp.namespace = exp.namespace or ns
-                    op.experiments.submit(exp, payload["trial_template"])
+                    spec = dict(payload["experiment"])
+                    if spec.get("namespace") not in (None, "", ns):
+                        raise ValueError(
+                            f"body namespace {spec['namespace']!r} != URL "
+                            f"namespace {ns!r}")
+                    spec["namespace"] = ns
+                    exp = experiment_from_dict(spec)
+                    with op._lock:
+                        op.experiments.submit(exp, payload["trial_template"])
                 except Exception as e:
                     return self._send(400, json.dumps({"error": str(e)}))
                 return self._send(
@@ -428,7 +443,8 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 return self._send(200, "{}")
             ns, name = self._resource_path("experiments")
             if ns and name and op.experiments is not None:
-                op.experiments.delete(ns, name)
+                with op._lock:
+                    op.experiments.delete(ns, name)
                 return self._send(200, "{}")
             ns, name = self._resource_path("inferenceservices")
             if ns and name and op.serving is not None:

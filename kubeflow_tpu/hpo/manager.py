@@ -85,18 +85,25 @@ class ExperimentManager:
                 key = (ns, name)
                 if key in self.controllers:
                     continue
-                loaded = self.store.load(ns, name)
-                if loaded is None:
-                    continue
-                exp, _, props = loaded
-                if exp.succeeded or exp.failed:
-                    continue
-                template = props.get("trial_template")
-                if not template:
-                    continue
-                self.controllers[key] = ExperimentController.resume(
-                    ns, name, self._runner(template), self.store)
-                resumed.append(key)
+                # one corrupt/incompatible stored record (older WAL, renamed
+                # enum, tightened validation) must not crash-loop the whole
+                # daemon: skip it and keep booting
+                try:
+                    loaded = self.store.load(ns, name)
+                    if loaded is None:
+                        continue
+                    exp, _, props = loaded
+                    if exp.succeeded or exp.failed:
+                        continue
+                    template = props.get("trial_template")
+                    if not template:
+                        continue
+                    self.controllers[key] = ExperimentController.resume(
+                        ns, name, self._runner(template), self.store)
+                    resumed.append(key)
+                except Exception as e:
+                    print(f"resume_persisted: skipping {ns}/{name}: "
+                          f"{type(e).__name__}: {e}", flush=True)
         return resumed
 
     def tick(self) -> None:

@@ -246,6 +246,13 @@ ALGORITHMS = {
 
 def make_algorithm(exp: Experiment) -> SearchAlgorithm:
     name = exp.algorithm.name
+    if name == "enas":
+        # NAS controller lives in hpo.nas (imported lazily: it pulls in jax)
+        from kubeflow_tpu.hpo.nas import ENASSearch
+
+        return ENASSearch(exp.parameters, exp.objective,
+                          exp.algorithm.settings)
     if name not in ALGORITHMS:
-        raise ValueError(f"unknown algorithm {name!r}; have {sorted(ALGORITHMS)}")
+        raise ValueError(
+            f"unknown algorithm {name!r}; have {sorted(ALGORITHMS) + ['enas']}")
     return ALGORITHMS[name](exp.parameters, exp.objective, exp.algorithm.settings)

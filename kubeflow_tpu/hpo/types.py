@@ -2,8 +2,8 @@
 
 Capability parity with the reference's Katib CRDs (SURVEY.md §2.3:
 Experiment/Suggestion/Trial with parallelism, objective goal, max trial
-counts, early stopping, NAS out of scope for round 1), redesigned for the
-TPU stack:
+counts, early stopping; NAS via ``algorithm.name="enas"`` and the DARTS
+one-shot searcher in ``hpo.nas``), redesigned for the TPU stack:
 
 - Trials are JAXJobs (or local callables in tests) — the trial template is a
   JobSpec factory with ``${param}`` substitution, mirroring Katib's

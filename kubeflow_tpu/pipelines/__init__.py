@@ -10,7 +10,7 @@ from kubeflow_tpu.pipelines.dsl import (
     ParallelFor, Pipeline, Task, component, pipeline,
 )
 from kubeflow_tpu.pipelines.runner import (
-    LocalRunner, RunResult, TaskResult, TaskState,
+    LocalRunner, RunResult, TaskResult, TaskState, run_status,
 )
 
 __all__ = [
@@ -18,4 +18,5 @@ __all__ = [
     "LocalRunner", "Metrics", "Model", "Output", "ParallelFor", "Pipeline",
     "PipelineClient", "RecurringRun", "RunResult", "Task", "TaskResult",
     "TaskState", "compile_pipeline", "component", "load_ir", "pipeline",
+    "run_status",
 ]

@@ -118,6 +118,12 @@ class LocalRunner:
         os.makedirs(run_dir, exist_ok=True)
         context_id = self.metadata.put_context(
             "pipeline_run", run_id, properties={"pipeline": pipe.name})
+        # cross-process run state (the persistence-agent role): a status
+        # execution any other process can read via run_status()
+        status_id = self.metadata.put_execution(
+            "pipeline_run_status", name=f"{run_id}/status", state="RUNNING",
+            properties={"pipeline": pipe.name})
+        self.metadata.associate(context_id, status_id)
 
         instances = self._expand(ctx, args)
         results = {name: TaskResult(name=name) for name in instances}
@@ -136,6 +142,10 @@ class LocalRunner:
 
         state = (TaskState.FAILED if run_failed.is_set()
                  else TaskState.SUCCEEDED)
+        self.metadata.update_execution(
+            status_id, state=state.value.upper(),
+            properties={"tasks": {
+                n: r.state.value for n, r in results.items()}})
         return RunResult(run_id=run_id, state=state, tasks=results,
                          params=args, context_id=context_id)
 
@@ -486,6 +496,24 @@ class LocalRunner:
             v._mlmd_id = aid
             self.metadata.put_event(eid, aid, OUTPUT, path=oname)
             self.metadata.attribute(context_id, aid)
+
+
+def run_status(metadata, run_id: str) -> Optional[dict]:
+    """Read a run's persisted state from ANY process holding the metadata
+    backend (in-proc WAL replay or the native server) — the reference's
+    persistence-agent role: run state outlives the runner process."""
+    ctx = metadata.context_by_name("pipeline_run", run_id)
+    if ctx is None:
+        return None
+    for ex in metadata.executions_in_context(ctx.id):
+        if ex.type == "pipeline_run_status":
+            return {
+                "run_id": run_id,
+                "pipeline": ex.properties.get("pipeline", ""),
+                "state": ex.state,
+                "tasks": ex.properties.get("tasks", {}),
+            }
+    return None
 
 
 def _jsonable(v: Any) -> bool:

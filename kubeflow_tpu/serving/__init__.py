@@ -13,8 +13,10 @@ from kubeflow_tpu.serving.model import (
 from kubeflow_tpu.serving.protocol import (
     InferRequest, InferResponse, InferTensor,
 )
+from kubeflow_tpu.serving.agents import BatchingModel, LoggingModel, ModelPuller
 from kubeflow_tpu.serving.router import GraphRouter, TrafficSplitter
 from kubeflow_tpu.serving.server import InferenceClient, ModelServer
+from kubeflow_tpu.serving.v2_socket import V2SocketClient, V2SocketServer
 from kubeflow_tpu.serving.storage import download
 from kubeflow_tpu.serving.types import (
     ComponentSpec, GraphNode, GraphNodeType, GraphStep, InferenceGraph,
@@ -23,12 +25,13 @@ from kubeflow_tpu.serving.types import (
 )
 
 __all__ = [
-    "Autoscaler", "ComponentSpec", "GenRequest", "GraphNode", "GraphNodeType",
+    "Autoscaler", "BatchingModel", "ComponentSpec", "GenRequest", "GraphNode",
+    "GraphNodeType", "LoggingModel", "ModelPuller",
     "GraphRouter", "GraphStep", "InferRequest", "InferResponse",
     "InferTensor", "InferenceClient", "InferenceGraph", "InferenceService",
     "JAXModel", "LLMEngine", "LLMModel", "Model", "ModelFormat",
     "ModelMissing", "ModelNotReady", "ModelRepository", "ModelServer",
     "PredictorSpec", "RuntimeRegistry", "SamplingParams", "ServingController",
-    "ServingRuntime", "TrafficSplitter", "TrainedModel", "download",
-    "enable_compile_cache",
+    "ServingRuntime", "TrafficSplitter", "TrainedModel", "V2SocketClient",
+    "V2SocketServer", "download", "enable_compile_cache",
 ]

@@ -10,6 +10,7 @@ the previous ready revision and the canary revision.
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -304,10 +305,14 @@ class ServingTicker:
 
     def __init__(self, controller: ServingController,
                  autoscaler: Optional["Autoscaler"] = None,
-                 concurrency_of=None):
+                 concurrency_of=None, lock=None):
         self.controller = controller
         self.autoscaler = autoscaler
         self.concurrency_of = concurrency_of or self._probe_concurrency
+        # mutation lock (the operator injects its own): the concurrency
+        # probe does blocking HTTP and must NOT hold it — a slow predictor
+        # pod must never stall job reconcile/heartbeat/API threads
+        self.lock = lock or threading.Lock()
 
     def _probe_concurrency(self, isvc: InferenceService) -> float:
         import urllib.request
@@ -329,14 +334,17 @@ class ServingTicker:
 
     def tick(self) -> None:
         for (ns, name) in list(self.controller.services.keys()):
-            isvc = self.controller.reconcile(ns, name)
+            with self.lock:
+                isvc = self.controller.reconcile(ns, name)
             if self.autoscaler is None or isvc is None:
                 continue
             if not isvc.status.ready:
                 continue
-            desired = self.autoscaler.scale(isvc, self.concurrency_of(isvc))
-            if desired != self.controller._predictor_replicas(isvc):
-                self.controller.set_scale(ns, name, desired)
+            concurrency = self.concurrency_of(isvc)     # unlocked HTTP
+            with self.lock:
+                desired = self.autoscaler.scale(isvc, concurrency)
+                if desired != self.controller._predictor_replicas(isvc):
+                    self.controller.set_scale(ns, name, desired)
 
 
 class Autoscaler:

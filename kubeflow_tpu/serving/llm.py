@@ -101,7 +101,12 @@ class LLMEngine:
 
     def __init__(self, params, cfg: llama.LlamaConfig, *,
                  max_batch: int = 8, max_seq: int = 1024,
-                 prefill_buckets: Sequence[int] = (64, 128, 256, 512)):
+                 prefill_buckets: Sequence[int] = (64, 128, 256, 512),
+                 kv_block_size: Optional[int] = None,
+                 kv_num_blocks: Optional[int] = None,
+                 decode_chunk: int = 8):
+        from kubeflow_tpu.serving.paged_kv import PagedKV
+
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -109,7 +114,30 @@ class LLMEngine:
         self.buckets = sorted(b for b in prefill_buckets if b <= max_seq)
         if not self.buckets:
             raise ValueError("no prefill bucket fits max_seq")
-        self.cache = llama.init_cache(cfg, max_batch, max_seq)
+        # block-paged KV: pool memory = kv_num_blocks * kv_block_size tokens
+        # (default: the dense arena's capacity + the scratch block); shrink
+        # kv_num_blocks to serve more concurrent requests per byte.
+        # Block size must divide max_seq and every bucket (prefill writes
+        # whole blocks); the default picks the largest power of 2 <= 64
+        # that does.
+        if kv_block_size is None:
+            kv_block_size = 1
+            while (kv_block_size < 64
+                   and max_seq % (kv_block_size * 2) == 0
+                   and all(b % (kv_block_size * 2) == 0
+                           for b in self.buckets)):
+                kv_block_size *= 2
+        for b in self.buckets + [max_seq]:
+            if b % kv_block_size:
+                raise ValueError(
+                    f"kv_block_size={kv_block_size} must divide max_seq and "
+                    f"every prefill bucket (got {b})")
+        if kv_num_blocks is None:
+            kv_num_blocks = max_batch * (max_seq // kv_block_size) + 1
+        self.paged = PagedKV(cfg=cfg, max_batch=max_batch, max_seq=max_seq,
+                             block_size=kv_block_size,
+                             num_blocks=kv_num_blocks)
+        self.cache = self.paged.cache
         self._free: list[int] = list(range(max_batch))
         self._active: dict[int, GenRequest] = {}     # slot -> request
         self._waiting: list[GenRequest] = []
@@ -120,6 +148,12 @@ class LLMEngine:
         self._rng = jax.random.key(0)
         self.steps = 0
         self.generated_tokens = 0
+        # multi-step decode: one dispatch runs `decode_chunk` decode+sample
+        # steps under lax.scan, amortizing host->device dispatch latency
+        # (vLLM multistep role). Requests finishing mid-chunk are trimmed on
+        # the host; their overshoot tokens land in their own reserved blocks
+        # or the scratch block, never another request's.
+        self.decode_chunk = max(1, int(decode_chunk))
 
         self._prefill = jax.jit(
             lambda p, toks, lens, cache: llama.prefill(
@@ -129,28 +163,37 @@ class LLMEngine:
 
     # ---------------- jitted bodies ----------------
 
-    def _decode_impl(self, params, token, cache, active, temperature,
+    def _decode_impl(self, params, token, cache, tables, active, temperature,
                      top_k, top_p, rng):
-        logits, cache = llama.decode_step(params, token, self.cfg, cache)
-        nxt = sample_logits(logits, rng, temperature, top_k, top_p)
-        # idle slots: pin len to 0 so their cursor can't creep toward max_seq
-        cache["len"] = jnp.where(active, cache["len"], 0)
-        return nxt, cache
+        from kubeflow_tpu.serving.paged_kv import paged_decode_step
 
-    def _insert_impl(self, cache, k_new, v_new, length, slot):
-        # k_new/v_new: [L, 1, bucket, H, K] -> rows [slot, :bucket] of arena
-        k = jax.lax.dynamic_update_slice(
-            cache["k"], k_new.astype(cache["k"].dtype), (0, slot, 0, 0, 0))
-        v = jax.lax.dynamic_update_slice(
-            cache["v"], v_new.astype(cache["v"].dtype), (0, slot, 0, 0, 0))
-        ln = cache["len"].at[slot].set(length)
-        return {"k": k, "v": v, "len": ln}
+        def one_step(carry, rng_step):
+            token, cache = carry
+            logits, cache = paged_decode_step(
+                params, token, self.cfg, cache, tables)
+            nxt = sample_logits(logits, rng_step, temperature, top_k, top_p)
+            # idle slots: pin len to 0 so the cursor can't creep toward
+            # max_seq (their scatter lands in the scratch block 0)
+            cache["len"] = jnp.where(active, cache["len"], 0)
+            return (nxt, cache), nxt
+
+        rngs = jax.random.split(rng, self.decode_chunk)
+        (_, cache), toks = jax.lax.scan(one_step, (token, cache), rngs)
+        return toks, cache                       # toks: [chunk, B]
+
+    def _insert_impl(self, cache, k_new, v_new, blk_ids, length, slot):
+        from kubeflow_tpu.serving.paged_kv import paged_insert
+
+        return paged_insert(cache, k_new, v_new, blk_ids, length, slot)
 
     # ---------------- public API ----------------
 
-    def validate_prompt(self, prompt: Sequence[int]) -> None:
+    def validate_prompt(self, prompt: Sequence[int],
+                        sampling: Optional[SamplingParams] = None) -> None:
         """Raise if the prompt can't be served. Called by add_request; also
         callable up front to vet a whole batch before enqueuing any of it."""
+        from kubeflow_tpu.serving.paged_kv import blocks_for
+
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + 1 > self.max_seq:
@@ -161,12 +204,25 @@ class LLMEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds largest prefill "
                 f"bucket {self.buckets[-1]}")
+        if sampling is not None:
+            # a reservation that can NEVER succeed must fail fast here —
+            # re-queueing it would spin generate()'s drain loop forever
+            need = min(
+                blocks_for(len(prompt) + sampling.max_tokens,
+                           self.paged.block_size),
+                self.paged.max_blocks_per_seq)
+            usable = self.paged.num_blocks - 1       # block 0 is scratch
+            if need > usable:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only has "
+                    f"{usable}; raise kv_num_blocks or lower max_tokens")
 
     def add_request(self, prompt: Sequence[int],
                     sampling: Optional[SamplingParams] = None) -> GenRequest:
-        self.validate_prompt(prompt)
+        sampling = sampling or SamplingParams()
+        self.validate_prompt(prompt, sampling)
         req = GenRequest(id=next(self._ids), prompt=list(map(int, prompt)),
-                         sampling=sampling or SamplingParams())
+                         sampling=sampling)
         with self._lock:
             self._waiting.append(req)
         return req
@@ -198,6 +254,7 @@ class LLMEngine:
             for slot, req in list(self._active.items()):
                 if req.id in aborted:
                     del self._active[slot]
+                    self.paged.release(slot)
                     self._free.append(slot)
         self._admit()
         if not self._active:
@@ -212,27 +269,34 @@ class LLMEngine:
             top_k[slot] = req.sampling.top_k
             top_p[slot] = req.sampling.top_p
         self._rng, step_rng = jax.random.split(self._rng)
-        nxt, self.cache = self._decode(
+        toks, self.cache = self._decode(
             self.params, jnp.asarray(self._tokens), self.cache,
+            jnp.asarray(self.paged.tables),
             jnp.asarray(active_mask), jnp.asarray(temp),
             jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
-        nxt = np.asarray(nxt)
-        self.steps += 1
+        toks = np.asarray(toks)                 # [chunk, B]
+        self.steps += toks.shape[0]
 
         finished = []
         for slot, req in list(self._active.items()):
-            tok = int(nxt[slot])
-            req.generated.append(tok)
-            self.generated_tokens += 1
-            self._tokens[slot] = tok
             eos = req.sampling.eos_id
-            if (eos is not None and tok == eos) or \
-                    len(req.generated) >= req.sampling.max_tokens or \
-                    len(req.prompt) + len(req.generated) >= self.max_seq:
-                req.done = True
-                finished.append(req)
-                del self._active[slot]
-                self._free.append(slot)
+            for t in range(toks.shape[0]):
+                tok = int(toks[t, slot])
+                req.generated.append(tok)
+                self.generated_tokens += 1
+                self._tokens[slot] = tok
+                if (eos is not None and tok == eos) or \
+                        len(req.generated) >= req.sampling.max_tokens or \
+                        len(req.prompt) + len(req.generated) >= self.max_seq:
+                    # mid-chunk overshoot tokens beyond this point are
+                    # trimmed (never appended); their cache writes went to
+                    # this slot's own blocks / scratch and die with the slot
+                    req.done = True
+                    finished.append(req)
+                    del self._active[slot]
+                    self.paged.release(slot)
+                    self._free.append(slot)
+                    break
         return finished
 
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -247,12 +311,26 @@ class LLMEngine:
     # ---------------- internals ----------------
 
     def _admit(self) -> None:
+        from kubeflow_tpu.serving.paged_kv import blocks_for
+
         while True:
             with self._lock:
                 if not self._waiting or not self._free:
                     return
                 req = self._waiting.pop(0)
                 slot = self._free.pop()
+            # reserve the blocks this request can ever touch; when the pool
+            # is exhausted the request waits at the HEAD of the queue (FIFO
+            # under memory pressure — later arrivals must not starve it)
+            bs = self.paged.block_size
+            nb_prefill = blocks_for(len(req.prompt), bs)
+            if not self.paged.reserve(slot, len(req.prompt),
+                                      req.sampling.max_tokens,
+                                      min_blocks=nb_prefill):
+                with self._lock:
+                    self._waiting.insert(0, req)
+                self._free.append(slot)
+                return
             bucket = _bucket(len(req.prompt), self.buckets)
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :len(req.prompt)] = req.prompt
@@ -267,8 +345,13 @@ class LLMEngine:
                 jnp.asarray([req.sampling.top_k], jnp.int32),
                 jnp.asarray([req.sampling.top_p], jnp.float32))
             first_tok = int(np.asarray(first)[0])
+            # only the blocks covering the true prompt length are written
+            # (pad rows past them were never attended and never will be)
+            blk_ids = self.paged.slot_blocks(slot)[:nb_prefill]
             self.cache = self._insert(
-                self.cache, filled["k"], filled["v"],
+                self.cache, filled["k"][:, :, :nb_prefill * bs],
+                filled["v"][:, :, :nb_prefill * bs],
+                jnp.asarray(blk_ids, jnp.int32),
                 jnp.int32(len(req.prompt)), jnp.int32(slot))
             # the prefill-sampled token is generation token #1; decode
             # continues from it
@@ -282,4 +365,5 @@ class LLMEngine:
                     req.sampling.max_tokens <= 1:
                 req.done = True
                 del self._active[slot]
+                self.paged.release(slot)
                 self._free.append(slot)

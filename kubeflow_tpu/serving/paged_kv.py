@@ -1,0 +1,198 @@
+"""Block-paged KV cache for the LLM engine (vLLM's PagedAttention role,
+SURVEY.md §2.4 LLM row), XLA-first.
+
+The dense engine arena ([L, max_batch, max_seq, KV, D]) charges every slot
+for the worst-case sequence length. Here KV lives in a pool of fixed-size
+blocks ([L, num_blocks, block_size, KV, D]) and each slot owns a *block
+table* — the ordered block ids backing its logical sequence — so arena
+memory scales with tokens actually resident, and a pool holding
+``num_blocks * block_size`` tokens can serve far more concurrent short
+requests than the dense arena of equal bytes.
+
+Everything stays static-shape for XLA: the pool and the [max_batch,
+max_blocks_per_seq] table array never change shape; tables are
+host-managed numpy (the scheduler allocates blocks at admission — enough
+for prompt + max_tokens, so decode can never run out mid-flight) and ride
+into the jitted step as a plain traced argument. The decode step gathers
+each slot's blocks into its logical [max_seq] view; XLA fuses the gather
+into the attention reads. (A Pallas block-resident paged-attention kernel
+can replace the gather later without changing this interface.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.ops.attention import decode_attention
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
+
+
+def init_paged_cache(cfg: llama.LlamaConfig, max_batch: int, max_seq: int,
+                     block_size: int, num_blocks: int, dtype=None) -> dict:
+    """Pool + per-slot lengths. ``num_blocks`` bounds total resident tokens
+    (num_blocks * block_size), independent of max_batch * max_seq."""
+    if max_seq % block_size:
+        raise ValueError(f"max_seq={max_seq} not a multiple of "
+                         f"block_size={block_size}")
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "len": jnp.zeros((max_batch,), jnp.int32),
+    }
+
+
+class BlockAllocator:
+    """Host-side free list over the pool's block ids.
+
+    Block 0 is never handed out: idle slots' table rows are all-zero and
+    the decode scatter still writes their (masked, garbage) row somewhere —
+    block 0 is that scratch target, so it must never back live data."""
+
+    def __init__(self, num_blocks: int):
+        self._free = list(range(1, num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        if n > len(self._free):
+            return None
+        out = self._free[:n]
+        del self._free[:n]
+        return out
+
+    def free(self, ids) -> None:
+        self._free.extend(int(i) for i in ids)
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    return -(-tokens // block_size)
+
+
+@dataclasses.dataclass
+class PagedKV:
+    """The engine-facing bundle: pool dict + host block tables/allocator."""
+
+    cfg: llama.LlamaConfig
+    max_batch: int
+    max_seq: int
+    block_size: int
+    num_blocks: int
+
+    def __post_init__(self):
+        self.cache = init_paged_cache(
+            self.cfg, self.max_batch, self.max_seq, self.block_size,
+            self.num_blocks)
+        self.max_blocks_per_seq = self.max_seq // self.block_size
+        self.tables = np.zeros(
+            (self.max_batch, self.max_blocks_per_seq), np.int32)
+        self.allocator = BlockAllocator(self.num_blocks)
+        self._slot_blocks: dict[int, list[int]] = {}
+
+    # ---- host-side scheduling ----
+
+    def reserve(self, slot: int, prompt_len: int, max_tokens: int,
+                min_blocks: int = 0) -> bool:
+        """Reserve every block the request can ever touch (prompt + all
+        generated tokens) so decode never exhausts the pool mid-flight.
+        ``min_blocks`` lets prefill demand bucket-coverage."""
+        need = max(blocks_for(prompt_len + max_tokens, self.block_size),
+                   min_blocks)
+        need = min(need, self.max_blocks_per_seq)
+        ids = self.allocator.alloc(need)
+        if ids is None:
+            return False
+        self._slot_blocks[slot] = ids
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[:len(ids)] = ids
+        self.tables[slot] = row
+        return True
+
+    def release(self, slot: int) -> None:
+        ids = self._slot_blocks.pop(slot, None)
+        if ids:
+            self.allocator.free(ids)
+        self.tables[slot] = 0
+
+    def slot_blocks(self, slot: int) -> list[int]:
+        return list(self._slot_blocks.get(slot, []))
+
+
+# ------------------------------------------------------------ jitted bodies
+
+def paged_insert(cache, k_new, v_new, blk_ids, length, slot):
+    """Write a prefilled request's KV rows into its blocks.
+
+    k_new/v_new: [L, 1, T, KV, D] with T a multiple of block_size and
+    T == len(blk_ids) * block_size (the caller slices to the covered
+    blocks); blk_ids: [nb] int32 pool destinations."""
+    L = cache["k"].shape[0]
+    bs = cache["k"].shape[2]
+    nb = blk_ids.shape[0]
+    kb = k_new.reshape(L, nb, bs, *k_new.shape[3:]).astype(cache["k"].dtype)
+    vb = v_new.reshape(L, nb, bs, *v_new.shape[3:]).astype(cache["v"].dtype)
+    k = cache["k"].at[:, blk_ids].set(kb)
+    v = cache["v"].at[:, blk_ids].set(vb)
+    ln = cache["len"].at[slot].set(length)
+    return {"k": k, "v": v, "len": ln}
+
+
+def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
+    """One decode step over the paged pool. token: [B] int32; tables:
+    [B, max_blocks_per_seq] int32 -> (logits [B, V], cache)."""
+    b = token.shape[0]
+    bs = cache["k"].shape[2]
+    pos = cache["len"]                                   # [B]
+    positions = pos[:, None]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+    x = params["embed"].astype(cfg.dtype)[token[:, None]]
+
+    batch = jnp.arange(b)
+    blk = tables[batch, pos // bs]                       # [B] dest block
+    off = pos % bs                                       # [B] row in block
+
+    def block_fn(x, xs):
+        lp, k_pool, v_pool = xs                          # [NB, bs, KV, D]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        # scatter this step's KV row into each slot's current block
+        k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
+        # gather each slot's logical view: block j of slot b holds logical
+        # positions [j*bs, (j+1)*bs) — table order IS sequence order
+        k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
+        v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
+        o = decode_attention(q, k_view, v_view, pos + 1)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        x = x + o
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        down, _ = llama._ffn(h, lp, cfg)
+        x = x + down
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), {
+        "k": new_k, "v": new_v, "len": cache["len"] + 1
+    }

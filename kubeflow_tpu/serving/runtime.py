@@ -88,6 +88,15 @@ def main(argv=None) -> int:
     server = ModelServer(repo, host=host or "127.0.0.1", port=int(port))
     server.start()
     print(f"serving {model.name!r} at {server.url}", flush=True)
+    # optional binary data plane (the gRPC-port role; see serving/v2_socket)
+    v2_bind = env.get("KFT_V2_SOCKET_BIND")
+    if v2_bind:
+        from kubeflow_tpu.serving.v2_socket import V2SocketServer
+
+        vhost, _, vport = v2_bind.rpartition(":")
+        v2 = V2SocketServer(repo, host=vhost or "127.0.0.1",
+                            port=int(vport)).start()
+        print(f"v2-socket at {v2.address[0]}:{v2.address[1]}", flush=True)
     threading.Event().wait()           # serve until killed
     return 0
 

@@ -4,31 +4,79 @@ delegates this to user code; we own it).
 
 Async checkpointing: the device->host copy happens at `save()`, serialization
 runs in a background thread so the step loop keeps going.
+
+Remote durability (the "Orbax async checkpointing to GCS" spine, SURVEY.md
+§5): two paths —
+
+- ``directory`` may itself be a ``gs://`` bucket path: Orbax/TensorStore
+  streams directly to GCS (needs cloud credentials; untestable in this
+  environment, so it is passed through untouched).
+- ``mirror=``: save locally (fast, node-local SSD), then a background
+  worker replicates every *finished* step to the mirror URI and restore
+  falls back to the mirror when the local directory is empty — the
+  local-disk-lost recovery path. The default copier handles local/file://
+  mirrors (in production that path is a mounted bucket, e.g. GCS FUSE);
+  an injected ``copy_fn`` swaps in a real object-store client.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any
+import shutil
+import threading
+from typing import Any, Callable, Optional
 
 import orbax.checkpoint as ocp
 
+_REMOTE_SCHEMES = ("gs://", "s3://")
+
+
+def _is_remote(path: str) -> bool:
+    return path.startswith(_REMOTE_SCHEMES)
+
+
+def _strip_file_scheme(path: str) -> str:
+    return path[len("file://"):] if path.startswith("file://") else path
+
 
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True, mirror: Optional[str] = None,
+                 copy_fn: Optional[Callable[[str, str], None]] = None):
+        if _is_remote(directory):
+            # bucket-direct: TensorStore owns the IO; no local mkdir
+            self.directory = directory
+        else:
+            self.directory = os.path.abspath(_strip_file_scheme(directory))
+            os.makedirs(self.directory, exist_ok=True)
+        self.mirror = (_strip_file_scheme(mirror)
+                       if mirror and not _is_remote(mirror) else mirror)
+        self._copy = copy_fn or self._default_copy
+        self._mirror_lock = threading.Lock()
+        self._mirror_kick = threading.Event()
+        self._mirror_stop = threading.Event()
+        self._mirror_thread: Optional[threading.Thread] = None
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True, enable_async_checkpointing=async_save
+            max_to_keep=max_to_keep, create=True,
+            enable_async_checkpointing=async_save,
         )
+        if self.mirror is not None and not _is_remote(self.mirror):
+            os.makedirs(self.mirror, exist_ok=True)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
+    # ------------------------------------------------------------- save --
+
     def save(self, step: int, state: Any, force: bool = False):
-        return self._mgr.save(
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
+        if saved and self.mirror is not None:
+            self._kick_mirror()
+        return saved
 
     def restore(self, step: int | None = None, template: Any = None):
+        if self._mgr.latest_step() is None and self.mirror is not None:
+            self._fetch_from_mirror(step)
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             return None, None
@@ -45,6 +93,80 @@ class CheckpointManager:
 
     def wait(self):
         self._mgr.wait_until_finished()
+        if self.mirror is not None:
+            self.mirror_sync()
 
     def close(self):
+        self._mirror_stop.set()
+        self._mirror_kick.set()
+        if self._mirror_thread is not None:
+            self._mirror_thread.join(timeout=30)
         self._mgr.close()
+        if self.mirror is not None:
+            self.mirror_sync()
+
+    # ----------------------------------------------------------- mirror --
+
+    @staticmethod
+    def _default_copy(src: str, dst: str) -> None:
+        if _is_remote(dst):      # pragma: no cover - needs cloud creds
+            raise NotImplementedError(
+                f"no object-store client in this environment for {dst!r}; "
+                "pass copy_fn= (or mount the bucket and use its path)")
+        tmp = dst + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        shutil.copytree(src, tmp)
+        os.replace(tmp, dst)
+
+    def _kick_mirror(self) -> None:
+        if self._mirror_thread is None:
+            self._mirror_thread = threading.Thread(
+                target=self._mirror_loop, daemon=True, name="ckpt-mirror")
+            self._mirror_thread.start()
+        self._mirror_kick.set()
+
+    def _mirror_loop(self) -> None:
+        while not self._mirror_stop.is_set():
+            self._mirror_kick.wait()
+            self._mirror_kick.clear()
+            try:
+                self._mgr.wait_until_finished()
+                self.mirror_sync()
+            except Exception:        # mirror must never kill the step loop
+                pass
+
+    def mirror_sync(self) -> list[int]:
+        """Replicate every finished local step absent from the mirror.
+        Idempotent; returns the steps copied this call."""
+        if self.mirror is None or _is_remote(self.directory):
+            return []
+        copied = []
+        with self._mirror_lock:
+            for step in sorted(self._mgr.all_steps()):
+                src = os.path.join(self.directory, str(step))
+                dst = os.path.join(self.mirror, str(step))
+                if not os.path.isdir(src) or os.path.exists(dst):
+                    continue
+                self._copy(src, dst)
+                copied.append(step)
+        return copied
+
+    def _fetch_from_mirror(self, want: Optional[int] = None) -> Optional[int]:
+        """Local directory empty (node replaced / disk lost): pull the
+        requested step (or the newest) back so restore proceeds normally."""
+        if self.mirror is None or _is_remote(self.mirror):
+            return None
+        steps = [int(d) for d in os.listdir(self.mirror)
+                 if d.isdigit() and os.path.isdir(
+                     os.path.join(self.mirror, d))]
+        if not steps:
+            return None
+        if want is not None and want not in steps:
+            return None
+        step = want if want is not None else max(steps)
+        dst = os.path.join(self.directory, str(step))
+        if not os.path.exists(dst):
+            self._copy(os.path.join(self.mirror, str(step)), dst)
+        self._mgr.reload()
+        return step

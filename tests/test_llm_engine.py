@@ -31,6 +31,18 @@ def ref_greedy(params, cfg, prompt, n):
     return toks[len(prompt):]
 
 
+def assert_greedy_consistent(params, cfg, prompt, generated):
+    """Teacher-forced check tolerant of EXACT logit ties (bf16 activations
+    quantize; batched vs single decode may break a tie differently): every
+    generated token must be a maximizer of the reference logits."""
+    toks = list(prompt)
+    for g in generated:
+        logits = llama.forward(params, jnp.asarray([toks]), cfg)[0, -1]
+        assert float(logits[g]) >= float(jnp.max(logits)) - 1e-6, \
+            (toks, g, int(jnp.argmax(logits)))
+        toks.append(g)
+
+
 def test_engine_matches_full_forward(tiny):
     cfg, params = tiny
     eng = LLMEngine(params, cfg, max_batch=4, max_seq=64,
@@ -39,6 +51,65 @@ def test_engine_matches_full_forward(tiny):
     reqs = eng.generate(prompts, SamplingParams(max_tokens=6))
     for r in reqs:
         assert r.generated == ref_greedy(params, cfg, r.prompt, 6)
+
+
+def test_paged_kv_more_concurrency_per_byte(tiny):
+    """The paged-KV property: a pool of 16 usable blocks x 8 tokens = 128
+    resident tokens. A dense [max_batch, max_seq=64] arena of equal bytes
+    holds exactly TWO slots; the paged engine runs SIX short requests
+    concurrently inside the same budget — and still decodes exactly."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=8, max_seq=64,
+                    prefill_buckets=(8,),
+                    kv_block_size=8, kv_num_blocks=17)   # 16 usable + scratch
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+    # 3 + 12 = 15 tokens -> 2 blocks each; max_tokens > decode_chunk so the
+    # requests are still mid-flight after one chunked step
+    reqs = [eng.add_request(p, SamplingParams(max_tokens=12))
+            for p in prompts]
+    eng.step()
+    assert len(eng._active) == 6          # all resident at once: 12 blocks
+    while eng.has_work():
+        eng.step()
+    for r in reqs:
+        assert len(r.generated) == 12
+        assert_greedy_consistent(params, cfg, r.prompt, r.generated)
+
+
+def test_paged_kv_pool_exhaustion_queues_fifo(tiny):
+    """When the block pool is exhausted, admission stops at the queue head
+    (FIFO under memory pressure) and the waiter runs once blocks free up."""
+    cfg, params = tiny
+    # 8 usable blocks x 8 tokens; each request reserves 4 blocks (2 prompt
+    # tokens + 30 max_tokens = 32 tokens) -> exactly two fit
+    eng = LLMEngine(params, cfg, max_batch=8, max_seq=64,
+                    prefill_buckets=(8,),
+                    kv_block_size=8, kv_num_blocks=9)
+    reqs = [eng.add_request([i + 1, i + 2], SamplingParams(max_tokens=30))
+            for i in range(3)]
+    eng.step()
+    assert len(eng._active) == 2 and not reqs[2].done
+    assert eng.paged.allocator.free_blocks == 0
+    while eng.has_work():
+        eng.step()
+    assert all(r.done for r in reqs)
+    assert len(reqs[2].generated) == 30
+    assert_greedy_consistent(params, cfg, reqs[2].prompt, reqs[2].generated)
+    assert eng.paged.allocator.free_blocks == 8
+
+
+def test_paged_kv_impossible_reservation_fails_fast(tiny):
+    """A request whose block reservation can NEVER succeed must raise at
+    add_request, not spin generate()'s drain loop forever."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=8, max_seq=64,
+                    prefill_buckets=(8,),
+                    kv_block_size=8, kv_num_blocks=4)     # 3 usable blocks
+    with pytest.raises(ValueError, match="KV blocks"):
+        eng.add_request([1, 2], SamplingParams(max_tokens=40))
+    # a fitting request still serves normally
+    r = eng.generate([[1, 2]], SamplingParams(max_tokens=4))[0]
+    assert len(r.generated) == 4
 
 
 def test_engine_request_churn(tiny):

@@ -128,6 +128,28 @@ def test_run_end_to_end(tmp_path):
     assert 0 < metrics.metadata["loss"] < 1
 
 
+def test_run_state_readable_cross_process(tmp_path):
+    """Persistence-agent role: run state outlives the runner — a second
+    'process' (fresh store over the same WAL) reads final state + per-task
+    states via run_status()."""
+    from kubeflow_tpu.pipelines import run_status
+
+    wal = str(tmp_path / "md.wal")
+    runner = LocalRunner(str(tmp_path / "wd"),
+                         metadata=MetadataStore(wal_path=wal))
+    res = runner.run(train_pipeline, arguments={"n": 8, "lr": 0.5})
+    assert res.succeeded
+
+    other = MetadataStore(wal_path=wal)          # WAL replay = new process
+    st = run_status(other, res.run_id)
+    assert st is not None
+    assert st["state"] == "SUCCEEDED"
+    assert st["pipeline"] == train_pipeline.name
+    assert st["tasks"]["train"] == "Succeeded"
+    assert st["tasks"]["deploy"] == "Succeeded"
+    assert run_status(other, "nope") is None
+
+
 def test_condition_skips(tmp_path):
     runner = LocalRunner(str(tmp_path))
     # lr=0 -> loss=1.0 -> condition (loss < 0.9) false -> deploy skipped

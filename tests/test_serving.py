@@ -231,6 +231,69 @@ def test_autoscaler():
     assert sc.scale(isvc0, 0, now=20.0) == 0    # scale to zero
 
 
+def test_v2_socket_data_plane_roundtrip():
+    """The gRPC-role data plane: V2 infer + metadata + repository ops over
+    the length-prefixed socket protocol, sharing the REST path's
+    proto-shaped dicts (recorded no-grpcio substitution)."""
+    from kubeflow_tpu.serving import V2SocketClient, V2SocketServer
+
+    repo = ModelRepository()
+    repo.register(Doubler("double"))
+    repo.register(AddOne("addone"))
+    srv = V2SocketServer(repo).start()
+    try:
+        cli = V2SocketClient(srv.address)
+        assert cli.server_live() and cli.server_ready()
+        assert cli.model_ready("double")
+        meta = cli.model_metadata("double")
+        assert meta["name"] == "double"
+
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        req = InferRequest(model_name="double", inputs=[
+            InferTensor.from_numpy("x", arr)], id="r1")
+        out = cli.infer(req)
+        np.testing.assert_array_equal(out.as_numpy(), arr * 2)
+        assert out.id == "r1"
+
+        cli.unload("addone")
+        with pytest.raises(RuntimeError, match=r"\[404\]"):
+            cli.model_metadata("addone")
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_v2_socket_concurrent_clients():
+    from kubeflow_tpu.serving import V2SocketClient, V2SocketServer
+    import threading as th
+
+    repo = ModelRepository()
+    repo.register(Doubler("double"))
+    srv = V2SocketServer(repo).start()
+    errs = []
+
+    def worker(i):
+        try:
+            cli = V2SocketClient(srv.address)
+            arr = np.full((2, 2), float(i), np.float32)
+            req = InferRequest(model_name="double", inputs=[
+                InferTensor.from_numpy("x", arr)])
+            for _ in range(10):
+                out = cli.infer(req).as_numpy()
+                np.testing.assert_array_equal(out, arr * 2)
+            cli.close()
+        except Exception as e:          # pragma: no cover
+            errs.append(e)
+
+    threads = [th.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.stop()
+    assert not errs
+
+
 def test_serving_ticker_applies_autoscale():
     """Daemon path: ServingTicker reconciles + applies Autoscaler decisions
     to actual predictor pod counts (scale up on load, back down when idle,

@@ -1,0 +1,130 @@
+"""Agent-role tests: request batcher, payload logger, model puller
+([U] kserve:cmd/agent, SURVEY.md §2.4 'Agent sidecars')."""
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from kubeflow_tpu.serving import ModelRepository
+from kubeflow_tpu.serving.agents import BatchingModel, LoggingModel, ModelPuller
+from kubeflow_tpu.serving.model import Model
+from kubeflow_tpu.serving.protocol import InferRequest, InferResponse, InferTensor
+
+
+class Scaler(Model):
+    """y = 3x; records the batch sizes it actually saw."""
+
+    def __init__(self, name="scale"):
+        super().__init__(name)
+        self.seen_batches = []
+
+    def predict(self, request):
+        x = request.as_numpy()
+        self.seen_batches.append(x.shape[0])
+        return InferResponse.from_numpy(self.name, {"output-0": x * 3.0},
+                                        id=request.id)
+
+
+def _req(vals, rid=None):
+    return InferRequest(model_name="scale", id=rid, inputs=[
+        InferTensor.from_numpy("x", np.asarray(vals, np.float32))])
+
+
+def test_batcher_coalesces_concurrent_requests():
+    inner = Scaler()
+    batched = BatchingModel(inner, max_batch_size=8, max_latency_ms=50.0)
+    batched.load()
+    results = {}
+
+    def call(i):
+        out = batched(_req([[float(i)]], rid=str(i)))
+        results[i] = float(out.as_numpy()[0, 0])
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: 3.0 * i for i in range(8)}
+    # coalescing happened: fewer inner calls than outer requests
+    assert len(inner.seen_batches) < 8
+    assert sum(inner.seen_batches) == 8
+    batched.unload()
+
+
+def test_batcher_propagates_inner_errors():
+    class Boom(Model):
+        def predict(self, request):
+            raise RuntimeError("boom")
+
+    batched = BatchingModel(Boom("b"), max_latency_ms=1.0)
+    batched.load()
+    try:
+        batched(_req([[1.0]]))
+    except RuntimeError as e:
+        assert "boom" in str(e)
+    else:
+        raise AssertionError("expected inner error to propagate")
+    batched.unload()
+
+
+def test_batcher_reload_after_unload():
+    """The repository exposes hot load/unload: a batcher must survive the
+    unload->load cycle (fresh worker thread) and keep serving."""
+    batched = BatchingModel(Scaler(), max_latency_ms=1.0)
+    batched.load()
+    assert float(batched(_req([[1.0]])).as_numpy()[0, 0]) == 3.0
+    batched.unload()
+    batched.load()
+    assert float(batched(_req([[2.0]])).as_numpy()[0, 0]) == 6.0
+    batched.unload()
+
+
+def test_payload_logger_writes_jsonl(tmp_path):
+    sink = str(tmp_path / "payloads.jsonl")
+    logged = LoggingModel(Scaler(), sink)
+    logged.load()
+    logged(_req([[2.0]], rid="r-7"))
+    logged(_req([[4.0]], rid="r-8"))
+    logged.flush()
+    recs = [json.loads(l) for l in open(sink)]
+    assert [r["id"] for r in recs] == ["r-7", "r-8"]
+    assert np.asarray(recs[0]["request"]["inputs"][0]["data"]
+                      ).flatten().tolist() == [2.0]
+    assert np.asarray(recs[0]["response"]["outputs"][0]["data"]
+                      ).flatten().tolist() == [6.0]
+    logged.unload()
+
+
+def test_model_puller_syncs_config_dir(tmp_path):
+    cfg_dir = str(tmp_path / "models-config")
+    os.makedirs(cfg_dir)
+    repo = ModelRepository()
+    pulls = []
+
+    def factory(desc, local):
+        pulls.append((desc["name"], local))
+        return Scaler(desc["name"])
+
+    def fake_download(uri, dest):
+        # the puller role: artifacts land locally before load
+        os.makedirs(dest, exist_ok=True)
+        open(os.path.join(dest, "weights.bin"), "w").write(uri)
+        return dest
+
+    puller = ModelPuller(repo, cfg_dir, factory, download=fake_download)
+    assert puller.sync() == {"loaded": [], "unloaded": []}
+
+    with open(os.path.join(cfg_dir, "m1.json"), "w") as f:
+        json.dump({"name": "m1", "storage_uri": "file:///fake"}, f)
+    out = puller.sync()
+    assert out["loaded"] == ["m1"]
+    assert repo.get("m1").ready
+    assert os.path.exists(os.path.join(pulls[0][1], "weights.bin"))
+    assert puller.sync() == {"loaded": [], "unloaded": []}   # idempotent
+
+    os.remove(os.path.join(cfg_dir, "m1.json"))
+    assert puller.sync()["unloaded"] == ["m1"]
+    assert "m1" not in repo.names()
