@@ -50,6 +50,45 @@ class TrainingClient:
         )
         return self.create_job(job)
 
+    def train(
+        self,
+        name: str,
+        func: Callable,
+        func_args: Optional[dict] = None,
+        *,
+        workers: int = 1,
+        tpu: Optional[TPUSpec] = None,
+        mesh: Optional[dict] = None,
+        env: Optional[dict] = None,
+        run_policy: Optional[RunPolicy] = None,
+    ) -> JobSpec:
+        """The reference SDK's high-level ``train()`` sugar: ship a
+        self-contained Python function as the worker command of a JAXJob.
+
+        Like the reference, ``func`` must be importable-free-standing: its
+        source is extracted and templated into the container command, so
+        every import it needs goes INSIDE the function body. ``func_args``
+        must be JSON-serializable.
+        """
+        import inspect
+        import json
+        import sys
+        import textwrap
+
+        src = textwrap.dedent(inspect.getsource(func))
+        if func.__name__.startswith("<"):
+            raise ValueError("train() needs a named def, not a lambda")
+        payload = json.dumps(func_args or {})
+        script = (
+            f"{src}\n"
+            f"import json as _kft_json\n"
+            f"{func.__name__}(**_kft_json.loads({payload!r}))\n"
+        )
+        return self.create_jax_job(
+            name, workers=workers, command=[sys.executable, "-c", script],
+            tpu=tpu, mesh=mesh, env=env, run_policy=run_policy,
+        )
+
     def get_job(self, name: str) -> Optional[JobSpec]:
         return self.controller.get(self.namespace, name)
 

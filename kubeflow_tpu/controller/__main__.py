@@ -21,13 +21,19 @@ def main(argv=None) -> int:
                        help="HTTP port for API + /metrics (0 = ephemeral)")
     serve.add_argument("--cluster", choices=("local", "fake"), default="local",
                        help="pod backend: local subprocesses or in-memory")
-    serve.add_argument("--heartbeat-dir", default="/tmp/kft-heartbeats")
-    serve.add_argument("--heartbeat-timeout", type=float, default=60.0)
-    serve.add_argument("--reconcile-period", type=float, default=0.25)
-    serve.add_argument("--log-dir", default="/tmp/kft-pods")
-    serve.add_argument("--state-dir", default="/tmp/kft-state",
+    serve.add_argument("--config", default=None,
+                       help="platform config JSON (the ConfigMap tier); "
+                            "flags below override it")
+    serve.add_argument("--heartbeat-dir", default=None)
+    serve.add_argument("--heartbeat-timeout", type=float, default=None)
+    serve.add_argument("--reconcile-period", type=float, default=None)
+    serve.add_argument("--log-dir", default=None)
+    serve.add_argument("--state-dir", default=None,
                        help="durable platform state (metadata WAL, HPO "
                             "trial metrics)")
+    serve.add_argument("--auth-tokens", default=None,
+                       help="JSON file with bearer tokens + profile "
+                            "bindings; omit for an open (dev) API")
     args = parser.parse_args(argv)
 
     from kubeflow_tpu.controller.cluster import FakeCluster, LocalProcessCluster
@@ -36,24 +42,35 @@ def main(argv=None) -> int:
     from kubeflow_tpu.hpo.manager import ExperimentManager
     from kubeflow_tpu.hpo.persistence import ExperimentStore
     from kubeflow_tpu.metadata.store import MetadataStore
+    from kubeflow_tpu.platform.config import load_config
     from kubeflow_tpu.serving.controller import (
         Autoscaler, RuntimeRegistry, ServingController, ServingTicker,
     )
 
-    cluster = (LocalProcessCluster(log_dir=args.log_dir)
+    # three config tiers: dataclass defaults < --config file < flags
+    cfg = load_config(args.config, overrides={
+        "heartbeat_dir": args.heartbeat_dir,
+        "heartbeat_timeout_s": args.heartbeat_timeout,
+        "reconcile_period": args.reconcile_period,
+        "log_dir": args.log_dir,
+        "state_dir": args.state_dir,
+    })
+
+    cluster = (LocalProcessCluster(log_dir=cfg.log_dir)
                if args.cluster == "local" else FakeCluster())
     controller = JobController(cluster)
+    controller.scheduler.aging_s = cfg.gang_aging_s
 
     # the whole platform in one daemon: training jobs + HPO experiments
     # (durable via the metadata WAL — a restart resumes unfinished sweeps)
     # + serving reconcile/autoscale
     import os
 
-    os.makedirs(args.state_dir, exist_ok=True)
+    os.makedirs(cfg.state_dir, exist_ok=True)
     store = ExperimentStore(MetadataStore(
-        wal_path=os.path.join(args.state_dir, "metadata.wal")))
+        wal_path=os.path.join(cfg.state_dir, "metadata.wal")))
     experiments = ExperimentManager(
-        controller, metrics_dir=os.path.join(args.state_dir, "trial-metrics"),
+        controller, metrics_dir=os.path.join(cfg.state_dir, "trial-metrics"),
         store=store)
     resumed = experiments.resume_persisted()
     # default runtimes so a POSTed InferenceService is servable out of the
@@ -68,13 +85,23 @@ def main(argv=None) -> int:
     serving = ServingTicker(
         ServingController(cluster, registry), Autoscaler())
 
+    auth = None
+    if args.auth_tokens:
+        from kubeflow_tpu.platform.auth import Auth
+
+        auth = Auth.from_file(args.auth_tokens)
+
     op = Operator(
         controller,
-        heartbeat_dir=args.heartbeat_dir,
-        heartbeat_timeout_s=args.heartbeat_timeout,
-        reconcile_period=args.reconcile_period,
+        heartbeat_dir=cfg.heartbeat_dir,
+        heartbeat_timeout_s=cfg.heartbeat_timeout_s,
+        startup_grace_s=cfg.startup_grace_s,
+        reconcile_period=cfg.reconcile_period,
+        heartbeat_period=cfg.heartbeat_period,
+        serving_period=cfg.serving_period,
         experiment_manager=experiments,
         serving_ticker=serving,
+        auth=auth,
     )
     port = op.start(port=args.port)
     if resumed:

@@ -84,6 +84,7 @@ class Operator:
         serving_period: float = 1.0,
         experiment_manager=None,
         serving_ticker=None,
+        auth=None,
     ):
         self.controller = controller
         # One lock serializes every compound mutation of controller state
@@ -107,6 +108,9 @@ class Operator:
         if serving_ticker is not None:
             serving_ticker.lock = self._lock
             serving_tickers += (serving_ticker.tick,)
+        # optional platform.auth.Auth: bearer-token authn + KFAM authz on
+        # every namespaced route (the istio/dex L1 role); None = open
+        self.auth = auth
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -341,11 +345,32 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
         def _job_path(self):
             return self._resource_path("jobs")
 
+        def _path_namespace(self):
+            parts = self.path.strip("/").split("/")
+            if (len(parts) >= 4 and parts[0] == "apis" and parts[1] == "v1"
+                    and parts[2] == "namespaces"):
+                return parts[3]
+            return None
+
+        def _authorized(self) -> bool:
+            """Enforce authn/authz on namespaced routes; sends the error
+            response itself when denied."""
+            if op.auth is None or self.path in ("/healthz", "/metrics"):
+                return True
+            res = op.auth.check(self.headers.get("Authorization"),
+                                self.command, self._path_namespace())
+            if not res.allowed:
+                self._send(res.status, json.dumps({"error": res.reason}))
+                return False
+            return True
+
         def do_GET(self):
             if self.path == "/healthz":
                 return self._send(200, "ok", "text/plain")
             if self.path == "/metrics":
                 return self._send(200, op.metrics.render(), "text/plain")
+            if not self._authorized():
+                return
             ns, name = self._job_path()
             if ns and name:
                 job = op.controller.get(ns, name)
@@ -383,6 +408,8 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length).decode()
+            if not self._authorized():
+                return
             ns, _ = self._job_path()
             if ns:
                 try:
@@ -427,7 +454,11 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
                     )
 
                     payload = json.loads(body)
-                    payload.setdefault("namespace", ns)
+                    if payload.get("namespace") not in (None, "", ns):
+                        raise ValueError(
+                            f"body namespace {payload['namespace']!r} != "
+                            f"URL namespace {ns!r}")
+                    payload["namespace"] = ns
                     isvc = inference_service_from_dict(payload)
                     with op._lock:
                         op.serving.controller.apply(isvc)
@@ -437,6 +468,8 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
             self._send(404, '{"error": "unknown path"}')
 
         def do_DELETE(self):
+            if not self._authorized():
+                return
             ns, name = self._job_path()
             if ns and name:
                 op.delete(ns, name)

@@ -225,8 +225,30 @@ class JobController:
             }
             spec = job.replica_specs[rtype]
             if spec.template.tpu is not None:
-                env["KFT_TPU_ACCELERATOR"] = spec.template.tpu.accelerator
-                env["KFT_TPU_TOPOLOGY"] = spec.template.tpu.topology
+                tpu = spec.template.tpu
+                env["KFT_TPU_ACCELERATOR"] = tpu.accelerator
+                env["KFT_TPU_TOPOLOGY"] = tpu.topology
+                # topology discovery (SURVEY.md §2.8): when the user gave no
+                # explicit mesh, derive one from the slice topology — fsdp
+                # over the slice's chips (ZeRO-3 default), DCN data across
+                # slices when the job spans several (gke-tpu-topology label
+                # -> mesh, without hand-written KFT_MESH)
+                if "KFT_MESH" not in spec.template.env:
+                    # size the mesh by the devices the job ACTUALLY has
+                    # (replicas x chips/host), not the slice type's full
+                    # chip count — a partial-slice job must still boot
+                    hosts_per_slice = max(1, tpu.num_hosts)
+                    w = spec.replicas
+                    if w > hosts_per_slice and w % hosts_per_slice == 0:
+                        # regular multislice: DCN data across slices
+                        env.setdefault(
+                            "KFT_MESH", f"fsdp={tpu.num_chips}")
+                        env.setdefault(
+                            "KFT_DCN", f"data={w // hosts_per_slice}")
+                    else:
+                        env.setdefault(
+                            "KFT_MESH",
+                            f"fsdp={w * tpu.chips_per_host}")
             return env
         if job.kind == "TFJob":
             cluster: dict[str, list[str]] = {}

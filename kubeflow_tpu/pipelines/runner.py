@@ -125,20 +125,28 @@ class LocalRunner:
             properties={"pipeline": pipe.name})
         self.metadata.associate(context_id, status_id)
 
-        instances = self._expand(ctx, args)
-        results = {name: TaskResult(name=name) for name in instances}
-        run_failed = threading.Event()
+        # any synchronous failure (expansion errors included) must finalize
+        # the status record — a dead run must never read as RUNNING forever
+        try:
+            instances = self._expand(ctx, args)
+            results = {name: TaskResult(name=name) for name in instances}
+            run_failed = threading.Event()
 
-        main = {n: i for n, i in instances.items()
-                if not i.task.is_exit_handler}
-        handlers = {n: i for n, i in instances.items()
-                    if i.task.is_exit_handler}
+            main = {n: i for n, i in instances.items()
+                    if not i.task.is_exit_handler}
+            handlers = {n: i for n, i in instances.items()
+                        if i.task.is_exit_handler}
 
-        self._execute_dag(main, results, args, run_dir, context_id,
-                          run_failed)
-        # exit handlers always run, even after failure
-        self._execute_dag(handlers, results, args, run_dir, context_id,
-                          threading.Event())
+            self._execute_dag(main, results, args, run_dir, context_id,
+                              run_failed)
+            # exit handlers always run, even after failure
+            self._execute_dag(handlers, results, args, run_dir, context_id,
+                              threading.Event())
+        except BaseException:
+            self.metadata.update_execution(
+                status_id, state="FAILED",
+                properties={"tasks": {}})
+            raise
 
         state = (TaskState.FAILED if run_failed.is_set()
                  else TaskState.SUCCEEDED)

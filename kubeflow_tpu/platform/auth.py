@@ -1,0 +1,91 @@
+"""Authentication + authorization for the operator API (L1 role).
+
+The reference fronts every surface with istio ingress + dex/oauth2-proxy
+(OIDC) and enforces authz with istio AuthorizationPolicies driven by KFAM
+(SURVEY.md §1 L1, §2.6). This environment has no OIDC provider, so —
+recorded substitution — authentication is bearer-token (static token →
+user map, the kubeconfig-token model), and authorization reuses the
+ProfileController's KFAM `can(user, namespace, verb)` with a
+cluster-admin override. The operator enforces both on every namespaced
+HTTP route; /healthz and /metrics stay open (probe/scrape convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from kubeflow_tpu.platform.profiles import ProfileController
+
+
+@dataclasses.dataclass
+class AuthResult:
+    user: Optional[str]          # None = unauthenticated
+    allowed: bool
+    status: int                  # 200 / 401 / 403
+    reason: str = ""
+
+
+class Auth:
+    """Bearer-token authn + profile-based authz, as one middleware object.
+
+    ``tokens``: token -> user. ``admins``: users allowed every verb in every
+    namespace (the cluster-admin ClusterRoleBinding role). ``profiles``: the
+    ProfileController whose owner/contributor/viewer bindings gate
+    namespaced access.
+    """
+
+    VERB_BY_METHOD = {"GET": "get", "POST": "create", "DELETE": "delete",
+                      "PUT": "update", "PATCH": "update"}
+
+    def __init__(self, tokens: dict[str, str],
+                 profiles: Optional[ProfileController] = None,
+                 admins: tuple = ()):
+        self.tokens = dict(tokens)
+        self.profiles = profiles
+        self.admins = set(admins)
+
+    @classmethod
+    def from_file(cls, path: str,
+                  profiles: Optional[ProfileController] = None) -> "Auth":
+        """JSON: {"tokens": {token: user}, "admins": [user],
+        "profiles": [{"name": ns, "owner": user,
+                      "contributors": [user]}]}."""
+        with open(path) as f:
+            spec = json.load(f)
+        if profiles is None and spec.get("profiles"):
+            from kubeflow_tpu.platform.profiles import Profile
+
+            profiles = ProfileController()
+            for p in spec["profiles"]:
+                prof = Profile(name=p["name"], owner=p["owner"])
+                profiles.apply(prof)
+                for c in p.get("contributors", []):
+                    profiles.add_contributor(p["name"], c)
+        return cls(spec.get("tokens", {}), profiles,
+                   tuple(spec.get("admins", ())))
+
+    def authenticate(self, authorization: Optional[str]) -> Optional[str]:
+        if not authorization or not authorization.startswith("Bearer "):
+            return None
+        return self.tokens.get(authorization[len("Bearer "):].strip())
+
+    def check(self, authorization: Optional[str], method: str,
+              namespace: Optional[str]) -> AuthResult:
+        user = self.authenticate(authorization)
+        if user is None:
+            return AuthResult(None, False, 401, "missing or invalid token")
+        if user in self.admins:
+            return AuthResult(user, True, 200)
+        verb = self.VERB_BY_METHOD.get(method, "get")
+        if namespace is None:
+            # namespaced resource path not matched: let the route handler
+            # 404; authenticated users may probe paths
+            return AuthResult(user, True, 200)
+        if self.profiles is not None and \
+                self.profiles.can(user, namespace, verb):
+            return AuthResult(user, True, 200)
+        return AuthResult(
+            user, False, 403,
+            f"user {user!r} may not {verb} in namespace {namespace!r}")

@@ -1,0 +1,86 @@
+"""Layered platform configuration (SURVEY.md §5 'Config / flag system').
+
+The reference layers: compiled defaults < platform ConfigMaps < binary
+flags. Same three tiers here: ``PlatformConfig`` dataclass defaults <
+a JSON config file (the ConfigMap role; hot-reloadable by mtime) <
+explicit CLI flag overrides. The operator consumes one resolved object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class PlatformConfig:
+    # operator loops
+    reconcile_period: float = 0.25
+    heartbeat_period: float = 1.0
+    heartbeat_timeout_s: float = 60.0
+    startup_grace_s: float = 300.0
+    serving_period: float = 1.0
+    # gang scheduling
+    gang_aging_s: float = 300.0
+    # paths
+    state_dir: str = "/tmp/kft-state"
+    log_dir: str = "/tmp/kft-pods"
+    heartbeat_dir: str = "/tmp/kft-heartbeats"
+    # serving defaults
+    default_max_batch: int = 8
+    default_max_seq: int = 1024
+
+    def merged(self, overrides: dict[str, Any]) -> "PlatformConfig":
+        """New config with non-None overrides applied (flag tier)."""
+        known = {f.name for f in dataclasses.fields(self)}
+        clean = {k: v for k, v in overrides.items()
+                 if k in known and v is not None}
+        return dataclasses.replace(self, **clean)
+
+
+def load_config(path: Optional[str] = None,
+                overrides: Optional[dict[str, Any]] = None) -> PlatformConfig:
+    """defaults < file (ConfigMap tier) < overrides (flag tier).
+    Unknown file keys fail loudly — a typo'd ConfigMap must not silently
+    fall back to defaults."""
+    cfg = PlatformConfig()
+    if path:
+        with open(path) as f:
+            data = json.load(f)
+        known = {f.name for f in dataclasses.fields(PlatformConfig)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"unknown config keys {sorted(unknown)}; known: "
+                f"{sorted(known)}")
+        cfg = dataclasses.replace(cfg, **data)
+    if overrides:
+        cfg = cfg.merged(overrides)
+    return cfg
+
+
+class ConfigWatcher:
+    """Mtime-based hot reload of the file tier (the ConfigMap-update role).
+    ``poll()`` returns the new config when the file changed, else None."""
+
+    def __init__(self, path: str, overrides: Optional[dict] = None):
+        self.path = path
+        self.overrides = overrides or {}
+        self._mtime = self._stat()
+        self.current = load_config(path, self.overrides)
+
+    def _stat(self) -> float:
+        try:
+            return os.path.getmtime(self.path)
+        except OSError:
+            return 0.0
+
+    def poll(self) -> Optional[PlatformConfig]:
+        m = self._stat()
+        if m != self._mtime:
+            self._mtime = m
+            self.current = load_config(self.path, self.overrides)
+            return self.current
+        return None

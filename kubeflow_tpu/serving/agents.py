@@ -48,22 +48,28 @@ class BatchingModel(Model):
         self._queue: "queue.Queue[tuple]" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._state_lock = threading.Lock()
         self.batches = 0                       # observability: flush count
 
     def load(self) -> bool:
         self.inner.load()
         # re-loadable after unload: fresh stop flag + worker thread (a
         # finished Thread object can never be start()ed again)
-        if self._worker is None or not self._worker.is_alive():
-            self._stop = threading.Event()
-            self._worker = threading.Thread(target=self._run, daemon=True)
-            self._worker.start()
-        self.ready = True
+        with self._state_lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._stop = threading.Event()
+                self._worker = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._worker.start()
+            self.ready = True
         return self.ready
 
     def unload(self) -> None:
-        self.ready = False
-        self._stop.set()
+        # ready flips under the same lock predict() enqueues under, so no
+        # request can slip into the queue after the drain below
+        with self._state_lock:
+            self.ready = False
+            self._stop.set()
         if self._worker is not None:
             self._worker.join(timeout=5)
             self._worker = None
@@ -80,9 +86,14 @@ class BatchingModel(Model):
         self.inner.unload()
 
     def predict(self, request: InferRequest) -> InferResponse:
+        from kubeflow_tpu.serving.model import ModelNotReady
+
         done = threading.Event()
         box: dict = {}
-        self._queue.put((request, done, box))
+        with self._state_lock:
+            if not self.ready:
+                raise ModelNotReady(self.name)
+            self._queue.put((request, done, box))
         done.wait()
         if "error" in box:
             raise box["error"]
@@ -140,16 +151,29 @@ class LoggingModel(Model):
         self.sink_path = sink_path
         self.mode = mode
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
-        self._worker = threading.Thread(target=self._drain, daemon=True)
-        self._worker.start()
+        # pending counts records enqueued but not yet WRITTEN (queue.empty()
+        # goes true before the write happens, so flush keys on this instead)
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._start_worker()
+
+    def _start_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
 
     def load(self) -> bool:
         self.inner.load()
+        self._start_worker()          # survives hot unload->load cycles
         self.ready = True
         return self.ready
 
     def unload(self) -> None:
         self._queue.put(None)
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+            self._worker = None
         self.inner.unload()
         self.ready = False
 
@@ -162,12 +186,17 @@ class LoggingModel(Model):
             rec["request"] = request.to_dict()
         if self.mode in ("all", "response"):
             rec["response"] = resp.to_dict()
+        with self._pending_lock:
+            self._pending += 1
         self._queue.put(rec)
         return resp
 
     def flush(self, timeout: float = 5.0) -> None:
         deadline = time.time() + timeout
-        while not self._queue.empty() and time.time() < deadline:
+        while time.time() < deadline:
+            with self._pending_lock:
+                if self._pending == 0:
+                    return
             time.sleep(0.01)
 
     def _drain(self) -> None:
@@ -180,6 +209,9 @@ class LoggingModel(Model):
                     f.write(json.dumps(rec) + "\n")
             except OSError:
                 pass
+            finally:
+                with self._pending_lock:
+                    self._pending -= 1
 
 
 class ModelPuller:

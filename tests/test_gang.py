@@ -124,6 +124,59 @@ def test_legacy_host_count_pool():
     assert pool.available_hosts == 3
 
 
+def test_topology_derives_default_mesh_env():
+    """Topology discovery: a TPU job with no explicit mesh gets KFT_MESH
+    derived from its slice topology (fsdp over the slice's chips) and a DCN
+    data axis when it spans multiple slices."""
+    from kubeflow_tpu.api.types import TPUSpec, jax_job
+    from kubeflow_tpu.controller import FakeCluster, JobController
+
+    ctl = JobController(FakeCluster())
+    # 8 workers of a 4-host "4x4" slice type -> 2 slices of 16 chips
+    job = jax_job("topo", workers=8, tpu=TPUSpec("v5e", "4x4"))
+    ctl.submit(job)
+    ctl.reconcile("default", "topo")
+    env = ctl.cluster_env(job, "Worker", 0)
+    assert env["KFT_MESH"] == "fsdp=16"
+    assert env["KFT_DCN"] == "data=2"
+
+    # single slice: no DCN axis
+    job2 = jax_job("topo1", workers=4, tpu=TPUSpec("v5e", "4x4"))
+    ctl.submit(job2)
+    ctl.reconcile("default", "topo1")
+    env2 = ctl.cluster_env(job2, "Worker", 1)
+    assert env2["KFT_MESH"] == "fsdp=16"
+    assert "KFT_DCN" not in env2
+
+    # partial slice: mesh sized by the job's ACTUAL devices (2 hosts x 4
+    # chips), not the slice type's 16 chips
+    jobp = jax_job("topo-part", workers=2, tpu=TPUSpec("v5e", "4x4"))
+    ctl.submit(jobp)
+    ctl.reconcile("default", "topo-part")
+    envp = ctl.cluster_env(jobp, "Worker", 0)
+    assert envp["KFT_MESH"] == "fsdp=8"
+    assert "KFT_DCN" not in envp
+
+    # explicit user mesh wins
+    job3 = jax_job("topo2", workers=4, tpu=TPUSpec("v5e", "4x4"),
+                   mesh={"data": 4, "tensor": 4})
+    ctl.submit(job3)
+    ctl.reconcile("default", "topo2")
+    env3 = ctl.cluster_env(job3, "Worker", 0)
+    assert "KFT_MESH" not in env3     # lives in the template env instead
+    assert job3.replica_specs["Worker"].template.env["KFT_MESH"] == \
+        "data=4,tensor=4"
+
+    # the derived env round-trips into a real mesh on the virtual devices
+    from kubeflow_tpu.parallel import mesh_from_topology_env
+    import jax
+
+    mesh = mesh_from_topology_env(
+        {"KFT_MESH": "fsdp=4", "KFT_DCN": "data=2"},
+        devices=jax.devices()[:8])
+    assert dict(mesh.shape)["fsdp"] == 4 and dict(mesh.shape)["data"] == 2
+
+
 def test_slice_id_placement_hint_reaches_pods():
     """Admitted workers learn their physical slice via KFT_SLICE_ID, spread
     over the reserved slices in contiguous replica-index blocks."""

@@ -147,6 +147,131 @@ def test_dashboard_http():
 
 # ---------------------------------------------------------------- manifests
 
+# ---------------------------------------------------------------- config
+
+def test_config_tiers(tmp_path):
+    """defaults < file (ConfigMap tier) < flags; typo'd keys fail loudly."""
+    import json as _json
+
+    import pytest as _pytest
+
+    from kubeflow_tpu.platform.config import ConfigWatcher, load_config
+
+    assert load_config().reconcile_period == 0.25
+    path = tmp_path / "platform.json"
+    path.write_text(_json.dumps({"reconcile_period": 1.5,
+                                 "gang_aging_s": 60}))
+    cfg = load_config(str(path))
+    assert cfg.reconcile_period == 1.5 and cfg.gang_aging_s == 60
+    cfg = load_config(str(path), overrides={"reconcile_period": 0.1,
+                                            "log_dir": None})
+    assert cfg.reconcile_period == 0.1            # flag beats file
+    assert cfg.log_dir == "/tmp/kft-pods"         # None override ignored
+
+    path.write_text(_json.dumps({"reconcile_perod": 1.0}))   # typo
+    with _pytest.raises(ValueError, match="unknown config keys"):
+        load_config(str(path))
+
+    # hot reload (the ConfigMap-update role)
+    path.write_text(_json.dumps({"serving_period": 2.0}))
+    w = ConfigWatcher(str(path))
+    assert w.poll() is None
+    path.write_text(_json.dumps({"serving_period": 9.0}))
+    os_utime_bump(path)
+    new = w.poll()
+    assert new is not None and new.serving_period == 9.0
+
+
+def os_utime_bump(path):
+    import os as _os
+
+    st = _os.stat(path)
+    _os.utime(path, (st.st_atime, st.st_mtime + 2))
+
+
+# ------------------------------------------------------------------ auth
+
+def _auth():
+    from kubeflow_tpu.platform.auth import Auth
+    from kubeflow_tpu.platform.profiles import Profile, ProfileController, Role
+
+    profiles = ProfileController()
+    profiles.apply(Profile(name="team-a", owner="alice@x.io"))
+    profiles.add_contributor("team-a", "viv@x.io", role=Role.VIEWER)
+    return Auth(tokens={"tok-alice": "alice@x.io", "tok-viv": "viv@x.io",
+                        "tok-root": "root@x.io"},
+                profiles=profiles, admins=("root@x.io",))
+
+
+def test_auth_check_matrix():
+    auth = _auth()
+    assert auth.check(None, "GET", "team-a").status == 401
+    assert auth.check("Bearer nope", "GET", "team-a").status == 401
+    assert auth.check("Bearer tok-alice", "POST", "team-a").allowed
+    assert auth.check("Bearer tok-viv", "GET", "team-a").allowed
+    r = auth.check("Bearer tok-viv", "POST", "team-a")
+    assert not r.allowed and r.status == 403
+    assert not auth.check("Bearer tok-alice", "GET", "team-b").allowed
+    assert auth.check("Bearer tok-root", "DELETE", "team-b").allowed
+
+
+def test_auth_from_file(tmp_path):
+    import json as _json
+
+    from kubeflow_tpu.platform.auth import Auth
+
+    path = tmp_path / "auth.json"
+    path.write_text(_json.dumps({
+        "tokens": {"t1": "a@x.io", "t2": "b@x.io"},
+        "admins": ["a@x.io"],
+        "profiles": [{"name": "ml", "owner": "b@x.io",
+                      "contributors": ["c@x.io"]}],
+    }))
+    auth = Auth.from_file(str(path))
+    assert auth.check("Bearer t1", "DELETE", "anywhere").allowed
+    assert auth.check("Bearer t2", "POST", "ml").allowed
+    assert auth.check("Bearer t2", "POST", "other").status == 403
+
+
+def test_operator_http_enforces_auth():
+    """The L1 boundary on the live API: 401 without a token, 403 for a
+    viewer's writes, 201 for the namespace owner, /healthz open."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.api.types import jax_job, to_yaml
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+
+    op = Operator(JobController(FakeCluster()), auth=_auth())
+    port = op.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert r.status == 200            # probes stay open
+
+        def call(path, token=None, data=None):
+            req = urllib.request.Request(
+                base + path, data=data,
+                headers={"Authorization": f"Bearer {token}"} if token else {})
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert call("/apis/v1/namespaces/team-a/jobs") == 401
+        assert call("/apis/v1/namespaces/team-a/jobs", "tok-viv") == 200
+        body = to_yaml(jax_job("j1", workers=1, namespace="team-a")).encode()
+        assert call("/apis/v1/namespaces/team-a/jobs", "tok-viv",
+                    body) == 403
+        assert call("/apis/v1/namespaces/team-a/jobs", "tok-alice",
+                    body) == 201
+        assert call("/apis/v1/namespaces/team-a/jobs", "tok-root") == 200
+    finally:
+        op.stop()
+
+
 def test_render_platform_no_gpu_and_complete():
     text = render_platform()
     docs = list(yaml.safe_load_all(text))
