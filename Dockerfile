@@ -1,0 +1,26 @@
+# The platform image every install-manifest Deployment references
+# (kubeflow-tpu/platform). One image, both roles: the operator daemon
+# (`python -m kubeflow_tpu.controller serve`) and the native metadata
+# store (`/opt/kft/native/metadata_store`).
+#
+#   docker build -t kubeflow-tpu/platform:latest .
+
+FROM python:3.12-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+COPY native /src/native
+RUN make -C /src/native/metadata_store
+
+FROM python:3.12-slim
+# the data plane: jax + the training/serving libraries the workers import
+RUN pip install --no-cache-dir \
+    "jax[cpu]" flax optax orbax-checkpoint chex einops numpy
+WORKDIR /opt/kft
+COPY kubeflow_tpu /opt/kft/kubeflow_tpu
+COPY examples /opt/kft/examples
+COPY --from=native-build /src/native/metadata_store/metadata_store \
+    /opt/kft/native/metadata_store
+ENV PYTHONPATH=/opt/kft
+EXPOSE 8080
+ENTRYPOINT ["python", "-m", "kubeflow_tpu.controller"]
+CMD ["serve", "--config", "/etc/kft/platform.json", "--state-dir", "/data"]

@@ -1,3 +1,4 @@
+from kubeflow_tpu.controller.chaos import FaultInjector
 from kubeflow_tpu.controller.cluster import (
     Cluster, FakeCluster, LocalProcessCluster, Pod, PodPhase, Service,
 )

@@ -13,12 +13,17 @@ import sys
 import threading
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The operator's flag surface — importable so the install-manifest
+    tests can validate rendered Deployment args against the REAL parser."""
     parser = argparse.ArgumentParser(prog="kubeflow_tpu.controller")
     sub = parser.add_subparsers(dest="cmd", required=True)
     serve = sub.add_parser("serve", help="run the operator daemon")
     serve.add_argument("--port", type=int, default=8080,
                        help="HTTP port for API + /metrics (0 = ephemeral)")
+    serve.add_argument("--bind-host", default="127.0.0.1",
+                       help="API bind address; in-cluster Deployments pass "
+                            "0.0.0.0 so probes/Services can reach it")
     serve.add_argument("--cluster", choices=("local", "fake"), default="local",
                        help="pod backend: local subprocesses or in-memory")
     serve.add_argument("--config", default=None,
@@ -34,7 +39,11 @@ def main(argv=None) -> int:
     serve.add_argument("--auth-tokens", default=None,
                        help="JSON file with bearer tokens + profile "
                             "bindings; omit for an open (dev) API")
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
 
     from kubeflow_tpu.controller.cluster import FakeCluster, LocalProcessCluster
     from kubeflow_tpu.controller.operator import Operator
@@ -91,6 +100,15 @@ def main(argv=None) -> int:
 
         auth = Auth.from_file(args.auth_tokens)
 
+    # the dashboard is part of the single binary: live views over the same
+    # controllers this daemon reconciles, scoped by the auth profiles
+    from kubeflow_tpu.platform.dashboard import Dashboard
+
+    dashboard = Dashboard(
+        jobs=controller, experiments=experiments.list,
+        serving=serving.controller,
+        profiles=auth.profiles if auth is not None else None)
+
     op = Operator(
         controller,
         heartbeat_dir=cfg.heartbeat_dir,
@@ -102,8 +120,9 @@ def main(argv=None) -> int:
         experiment_manager=experiments,
         serving_ticker=serving,
         auth=auth,
+        dashboard=dashboard,
     )
-    port = op.start(port=args.port)
+    port = op.start(port=args.port, host=args.bind_host)
     if resumed:
         print(f"kft-operator resumed experiments: {resumed}", flush=True)
     print(f"kft-operator serving on 127.0.0.1:{port}", flush=True)

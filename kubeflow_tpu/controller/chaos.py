@@ -1,0 +1,95 @@
+"""Fault injection (SURVEY.md §5 'failure detection / fault injection').
+
+The reference validates recovery with chaos tooling that deletes pods at
+random; this is the first-party equivalent over this repo's cluster
+backends — a harness the elasticity tests (and operators debugging
+recovery) drive:
+
+- FakeCluster: victims flip to FAILED with a retryable exit code.
+- LocalProcessCluster: victims get SIGKILL (exit < 0 — what the
+  EXIT_CODE restart policy classifies as retryable), exactly the
+  slice-preemption signature at scale.
+"""
+
+from __future__ import annotations
+
+import random
+import signal
+import threading
+from typing import Optional
+
+from kubeflow_tpu.controller.cluster import (
+    FakeCluster, LocalProcessCluster, PodPhase,
+)
+
+
+class FaultInjector:
+    """Kill pods of a cluster, one-shot or on a background schedule."""
+
+    def __init__(self, cluster, seed: int = 0):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.kills: list[tuple[str, str]] = []     # (namespace, pod name)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- one-shot
+
+    def kill_pod(self, namespace: str, name: str) -> bool:
+        """Fail one pod the way a preempted TPU host fails. Returns whether
+        a live victim was actually hit."""
+        if isinstance(self.cluster, LocalProcessCluster):
+            proc = self.cluster.procs.get((namespace, name))
+            if proc is None or proc.poll() is not None:
+                return False
+            proc.send_signal(signal.SIGKILL)
+            self.kills.append((namespace, name))
+            return True
+        if isinstance(self.cluster, FakeCluster):
+            pod = self.cluster.get_pod(namespace, name)
+            if pod is None or pod.phase not in (PodPhase.PENDING,
+                                                PodPhase.RUNNING):
+                return False
+            self.cluster.set_phase(namespace, name, PodPhase.FAILED,
+                                   exit_code=-9)
+            self.kills.append((namespace, name))
+            return True
+        raise TypeError(f"unsupported cluster {type(self.cluster).__name__}")
+
+    def kill_random(self, namespace: str,
+                    selector: Optional[dict] = None) -> Optional[str]:
+        """Kill one random matching live pod; returns its name or None."""
+        pods = [p for p in self.cluster.list_pods(namespace, selector or {})
+                if p is not None and p.phase in (PodPhase.PENDING,
+                                                 PodPhase.RUNNING)]
+        self.rng.shuffle(pods)
+        for pod in pods:
+            if self.kill_pod(namespace, pod.name):
+                return pod.name
+        return None
+
+    # ------------------------------------------------------------ schedule
+
+    def start(self, namespace: str, selector: Optional[dict] = None, *,
+              period_s: float = 1.0, kill_probability: float = 1.0,
+              max_kills: Optional[int] = None) -> None:
+        """Background chaos: every ``period_s``, with ``kill_probability``,
+        kill one random matching pod, up to ``max_kills`` victims."""
+
+        def loop():
+            while not self._stop.wait(period_s):
+                if max_kills is not None and len(self.kills) >= max_kills:
+                    return
+                if self.rng.random() <= kill_probability:
+                    self.kill_random(namespace, selector)
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="kft-chaos")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -85,6 +85,7 @@ class Operator:
         experiment_manager=None,
         serving_ticker=None,
         auth=None,
+        dashboard=None,
     ):
         self.controller = controller
         # One lock serializes every compound mutation of controller state
@@ -111,6 +112,9 @@ class Operator:
         # optional platform.auth.Auth: bearer-token authn + KFAM authz on
         # every namespaced route (the istio/dex L1 role); None = open
         self.auth = auth
+        # optional platform.dashboard.Dashboard: served at /dashboard
+        # (HTML) and /apis/v1/dashboard (JSON), user-scoped when auth is on
+        self.dashboard = dashboard
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -243,8 +247,10 @@ class Operator:
 
     # ---------------- lifecycle ----------------
 
-    def start(self, port: int = 0) -> int:
-        """Start loops + HTTP server; returns the bound port."""
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Start loops + HTTP server; returns the bound port. In-cluster
+        deployments pass host="0.0.0.0" so kubelet probes and Services can
+        reach the API; the default stays loopback for local dev."""
         self._threads = [
             threading.Thread(target=self._reconcile_loop, daemon=True,
                              name="kft-reconcile"),
@@ -257,7 +263,7 @@ class Operator:
                 target=self._serving_loop, daemon=True, name="kft-serving"))
         for t in self._threads:
             t.start()
-        self._httpd = _make_http_server(self, port)
+        self._httpd = _make_http_server(self, port, host)
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
                          name="kft-http").start()
@@ -320,7 +326,9 @@ def _job_to_dict(job) -> dict:
     }
 
 
-def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
+def _make_http_server(op: Operator, port: int,
+                      host: str = "127.0.0.1"
+                      ) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
             pass
@@ -371,6 +379,24 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 return self._send(200, op.metrics.render(), "text/plain")
             if not self._authorized():
                 return
+            if self.path in ("/dashboard", "/apis/v1/dashboard") and \
+                    op.dashboard is not None:
+                user = None
+                if op.auth is not None:
+                    user = op.auth.authenticate(
+                        self.headers.get("Authorization"))
+                    if user in op.auth.admins:
+                        user = None          # admins see every namespace
+                snap = op.dashboard.snapshot(user)
+                if self.path == "/apis/v1/dashboard":
+                    return self._send(200, json.dumps(snap))
+                rows = "".join(
+                    f"<h2>{k}</h2><pre>{json.dumps(v, indent=1)}</pre>"
+                    for k, v in snap.items())
+                return self._send(
+                    200, "<html><title>kubeflow-tpu</title><body>"
+                         f"<h1>kubeflow-tpu dashboard</h1>{rows}"
+                         "</body></html>", "text/html")
             ns, name = self._job_path()
             if ns and name:
                 job = op.controller.get(ns, name)
@@ -486,4 +512,4 @@ def _make_http_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 return self._send(200, "{}")
             self._send(404, '{"error": "unknown path"}')
 
-    return ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    return ThreadingHTTPServer((host, port), Handler)

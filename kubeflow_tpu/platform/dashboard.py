@@ -46,13 +46,15 @@ class Dashboard:
                 if visible(ns)
             ]
         if self.experiments is not None:
+            experiments = (self.experiments() if callable(self.experiments)
+                           else self.experiments)
             out["experiments"] = [
                 {"name": e.name,
                  "trials": len(e.trials),
                  "best": (e.best_trial.objective_value
                           if e.best_trial else None),
                  "done": e.succeeded or e.failed}
-                for e in self.experiments if visible(e.namespace)
+                for e in experiments if visible(e.namespace)
             ]
         if self.serving is not None:
             out["inference_services"] = [

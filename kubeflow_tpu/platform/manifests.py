@@ -17,6 +17,11 @@ import yaml
 API_GROUP = "kubeflow-tpu.org"
 VERSION = "v1"
 
+# Only kinds the installed daemon actually reconciles get CRDs: rendering
+# a CRD nothing watches strands user objects forever (review finding).
+# Pipelines/notebooks/tensorboards/profiles/poddefaults are SDK/library
+# tier in the single-binary architecture — their state lives in the
+# metadata store or the operator's auth file, not in CRs.
 CRD_KINDS = [
     ("jaxjobs", "JAXJob"),
     ("tfjobs", "TFJob"),
@@ -24,30 +29,32 @@ CRD_KINDS = [
     ("trials", "Trial"),
     ("inferenceservices", "InferenceService"),
     ("servingruntimes", "ServingRuntime"),
-    ("inferencegraphs", "InferenceGraph"),
-    ("trainedmodels", "TrainedModel"),
-    ("pipelines", "Pipeline"),
-    ("pipelineruns", "PipelineRun"),
-    ("recurringruns", "RecurringRun"),
-    ("profiles", "Profile"),
-    ("poddefaults", "PodDefault"),
-    ("notebooks", "Notebook"),
-    ("tensorboards", "TensorBoard"),
 ]
 
+# The single-binary architecture (SURVEY.md §7): ONE operator Deployment
+# runs the training + HPO + serving control loops AND the dashboard
+# (python -m kubeflow_tpu.controller serve — the REAL entrypoint in this
+# repo, built into the platform image by the root Dockerfile), plus the
+# native C++ metadata store (raw length-prefixed TCP — its probe is a TCP
+# socket check, never HTTP). Commands/args/ports here are validated
+# against the actual CLI parser and bind surface by tests — the install
+# path cannot drift from the codebase. Pipelines run through the SDK
+# (LocalRunner + durable run state in the metadata store), not a CRD
+# controller, so no pipelines apiserver Deployment exists to render.
+PLATFORM_IMAGE = "kubeflow-tpu/platform:latest"
+OPERATOR_ARGS = ["serve", "--config", "/etc/kft/platform.json",
+                 "--state-dir", "/data",
+                 "--auth-tokens", "/etc/kft/auth.json",
+                 "--bind-host", "0.0.0.0", "--port", "8080"]
 CONTROLLERS = [
-    # (name, image, args, needs_webhook)
-    ("training-controller", "kubeflow-tpu/controller:latest",
-     ["--enable-kind=JAXJob", "--enable-kind=TFJob",
-      "--gang-scheduler=builtin"], True),
-    ("hpo-controller", "kubeflow-tpu/controller:latest",
-     ["--enable-kind=Experiment"], True),
-    ("serving-controller", "kubeflow-tpu/controller:latest",
-     ["--enable-kind=InferenceService"], True),
-    ("pipelines-apiserver", "kubeflow-tpu/pipelines:latest", [], False),
-    ("metadata-store", "kubeflow-tpu/metadata-store:latest",
-     ["--port", "8081", "--wal", "/data/metadata.wal"], False),
-    ("dashboard", "kubeflow-tpu/dashboard:latest", [], False),
+    # (name, image, command, args, port, probe)
+    ("kft-operator", PLATFORM_IMAGE,
+     ["python", "-m", "kubeflow_tpu.controller"], OPERATOR_ARGS,
+     8080, "http"),
+    ("metadata-store", PLATFORM_IMAGE,
+     ["/opt/kft/native/metadata_store"],
+     ["--port", "8081", "--wal", "/data/metadata.wal"],
+     8081, "tcp"),
 ]
 
 
@@ -73,7 +80,32 @@ def crd(plural: str, kind: str) -> dict:
 
 
 def deployment(name: str, image: str, args: list[str],
-               namespace: str = "kubeflow-tpu") -> dict:
+               namespace: str = "kubeflow-tpu",
+               command: Optional[list[str]] = None,
+               port: int = 8080, probe: str = "http") -> dict:
+    container = {
+        "name": name,
+        "image": image,
+        "args": list(args),
+        "ports": [{"containerPort": port, "name": "api"}],
+        "volumeMounts": [
+            {"name": "state", "mountPath": "/data"},
+            {"name": "platform-config", "mountPath": "/etc/kft"},
+        ],
+        # HTTP components probe /healthz; raw-TCP components (the native
+        # metadata store) get a socket check — an httpGet against them
+        # would CrashLoopBackOff the pod
+        "livenessProbe": (
+            {"httpGet": {"path": "/healthz", "port": port}}
+            if probe == "http" else
+            {"tcpSocket": {"port": port}}),
+        "resources": {
+            "requests": {"cpu": "100m", "memory": "256Mi"},
+            "limits": {"cpu": "2", "memory": "2Gi"},
+        },
+    }
+    if command:
+        container["command"] = list(command)
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -86,19 +118,51 @@ def deployment(name: str, image: str, args: list[str],
                 "metadata": {"labels": {"app": name}},
                 "spec": {
                     "serviceAccountName": name,
-                    "containers": [{
-                        "name": name,
-                        "image": image,
-                        "args": list(args),
-                        "ports": [{"containerPort": 8080, "name": "metrics"}],
-                        "resources": {
-                            "requests": {"cpu": "100m", "memory": "256Mi"},
-                            "limits": {"cpu": "2", "memory": "2Gi"},
-                        },
-                    }],
+                    "containers": [container],
+                    "volumes": [
+                        {"name": "state",
+                         "persistentVolumeClaim": {"claimName": f"{name}-state"}},
+                        {"name": "platform-config",
+                         "configMap": {"name": "kft-platform-config"}},
+                    ],
                 },
             },
         },
+    }
+
+
+def platform_configmap(namespace: str = "kubeflow-tpu",
+                       bootstrap_token: str = "CHANGE-ME-ON-INSTALL") -> dict:
+    """The ConfigMap tier the operator's --config flag consumes — generated
+    from the REAL PlatformConfig defaults so keys can't drift. The auth
+    file ships a bootstrap cluster-admin token (kubeadm-style: rotate it
+    right after install) — an empty token map would lock every API call
+    out of a fresh install."""
+    import dataclasses as dc
+    import json as _json
+
+    from kubeflow_tpu.platform.config import PlatformConfig
+
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "kft-platform-config", "namespace": namespace},
+        "data": {"platform.json": _json.dumps(
+            dc.asdict(PlatformConfig(state_dir="/data")), indent=2),
+            "auth.json": _json.dumps({
+                "tokens": {bootstrap_token: "bootstrap-admin@install"},
+                "admins": ["bootstrap-admin@install"]})},
+    }
+
+
+def pvc(name: str, namespace: str = "kubeflow-tpu",
+        size: str = "10Gi") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": size}}},
     }
 
 
@@ -164,10 +228,13 @@ def render_platform(namespace: str = "kubeflow-tpu",
     ]
     for plural, kind in CRD_KINDS:
         docs.append(crd(plural, kind))
-    for name, image, args, _webhook in CONTROLLERS:
+    docs.append(platform_configmap(namespace))
+    for name, image, command, args, port, probe in CONTROLLERS:
         docs.extend(rbac(name, namespace))
-        docs.append(deployment(name, image, args, namespace))
-        docs.append(service(name, 8080, namespace))
+        docs.append(pvc(f"{name}-state", namespace))
+        docs.append(deployment(name, image, args, namespace,
+                               command=command, port=port, probe=probe))
+        docs.append(service(name, port, namespace))
     docs = copy.deepcopy(docs)
     for overlay in overlays or []:
         overlay(docs)

@@ -79,11 +79,29 @@ def download(storage_uri: str, dest_dir: str) -> str:
             storage_uri, dest_dir,
             _from_huggingface(parsed.netloc + parsed.path, dest_dir))
     if scheme in ("gs", "s3", "azure"):
-        raise RuntimeError(
-            f"{scheme}:// downloads need the cloud SDK, which is not in "
-            f"this environment; mirror the model to a file:// or pvc:// "
-            f"path instead")
+        return _from_mounted_bucket(scheme, parsed, dest_dir)
     raise ValueError(f"unsupported storage uri scheme {scheme!r}")
+
+
+# Mounted-bucket convention: on GKE the pod webhook mounts buckets with
+# FUSE (gcsfuse / s3 mountpoint) under these roots, so gs://bucket/path is
+# readable as a plain directory — no cloud SDK in the serving image at all
+# (the TPU-native choice: the kernel page cache streams weights, and the
+# same path works for every framework). Override the root with
+# KFT_BUCKET_MOUNT_ROOT, e.g. in tests.
+_BUCKET_MOUNT_ROOTS = {"gs": "/gcs", "s3": "/s3", "azure": "/azure"}
+
+
+def _from_mounted_bucket(scheme: str, parsed, dest_dir: str) -> str:
+    root = os.environ.get("KFT_BUCKET_MOUNT_ROOT",
+                          _BUCKET_MOUNT_ROOTS[scheme])
+    path = os.path.join(root, parsed.netloc, parsed.path.lstrip("/"))
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"{scheme}://{parsed.netloc} is not mounted at {root} (expected "
+            f"{path}); mount the bucket (gcsfuse/mountpoint via the pod "
+            f"webhook) or mirror the model to file://")
+    return _from_local(path, dest_dir)
 
 
 def _from_local(path: str, dest_dir: str) -> str:

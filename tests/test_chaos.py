@@ -1,0 +1,101 @@
+"""Fault-injection harness tests (SURVEY.md §5): randomized pod kills, and
+unattended recovery of a real job under repeated chaos."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api.types import ConditionType, RestartPolicy, jax_job
+from kubeflow_tpu.controller import (
+    FakeCluster, FaultInjector, JobController, LocalProcessCluster, Operator,
+    PodPhase,
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER_CMD = [sys.executable, "-m", "kubeflow_tpu.rendezvous.worker_check"]
+
+
+def test_injector_kills_fake_pods_and_job_gang_restarts():
+    cluster = FakeCluster()
+    ctl = JobController(cluster)
+    job = jax_job("chaotic", workers=2, mesh={"data": 2})
+    job.replica_specs["Worker"].restart_policy = RestartPolicy.EXIT_CODE
+    ctl.submit(job)
+    ctl.reconcile("default", "chaotic")
+    for pod in cluster.list_pods("default", {"job-name": "chaotic"}):
+        cluster.set_phase("default", pod.name, PodPhase.RUNNING)
+
+    chaos = FaultInjector(cluster, seed=1)
+    victim = chaos.kill_random("default", {"job-name": "chaotic"})
+    assert victim is not None and chaos.kills == [("default", victim)]
+    ctl.reconcile("default", "chaotic")
+    out = ctl.get("default", "chaotic")
+    assert out.status.restart_count >= 1          # gang restart happened
+    # fresh pods exist again (recreated by the restart)
+    fresh = cluster.list_pods("default", {"job-name": "chaotic"})
+    assert all(p.phase == PodPhase.PENDING for p in fresh)
+
+
+def test_injector_scheduled_chaos_respects_max_kills():
+    cluster = FakeCluster()
+    ctl = JobController(cluster)
+    job = jax_job("bounded", workers=4, mesh={"data": 4})
+    ctl.submit(job)
+    ctl.reconcile("default", "bounded")
+    for pod in cluster.list_pods("default", {"job-name": "bounded"}):
+        cluster.set_phase("default", pod.name, PodPhase.RUNNING)
+    chaos = FaultInjector(cluster, seed=2)
+    chaos.start("default", {"job-name": "bounded"},
+                period_s=0.02, max_kills=2)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(chaos.kills) < 2:
+        time.sleep(0.05)
+    time.sleep(0.2)
+    chaos.stop()
+    assert len(chaos.kills) == 2                   # bounded blast radius
+
+
+def test_real_job_survives_scheduled_chaos(tmp_path):
+    """The recovery e2e: a real 2-process job under a chaos schedule that
+    SIGKILLs up to two workers still reaches Succeeded unattended."""
+    cluster = LocalProcessCluster(log_dir=str(tmp_path / "pods"))
+    ctl = JobController(cluster)
+    op = Operator(ctl, heartbeat_dir=str(tmp_path / "hb"),
+                  reconcile_period=0.1, heartbeat_period=0.25)
+    op.start(port=0)
+    chaos = FaultInjector(cluster, seed=3)
+    try:
+        job = jax_job(
+            "chaos-e2e", workers=2, mesh={"data": 2}, command=WORKER_CMD,
+            env={"PYTHONPATH": _REPO_ROOT + ":" + os.environ.get(
+                     "PYTHONPATH", ""),
+                 "KFT_FORCE_PLATFORM": "cpu",
+                 "KFT_TRAIN_STEPS": "3",
+                 "KFT_METRICS_PATH": str(tmp_path / "m.jsonl"),
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+        job.replica_specs["Worker"].restart_policy = RestartPolicy.EXIT_CODE
+        op.submit(job)
+        # wait until workers are actually alive, then unleash chaos
+        deadline = time.time() + 60
+        while time.time() < deadline and not any(
+                k[1].startswith("chaos-e2e") and p.poll() is None
+                for k, p in list(cluster.procs.items())):
+            time.sleep(0.1)
+        chaos.start("default", {"job-name": "chaos-e2e"},
+                    period_s=1.5, max_kills=2)
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            out = ctl.get("default", "chaos-e2e")
+            if out is not None and out.status.is_finished():
+                break
+            time.sleep(0.3)
+        chaos.stop()
+        assert out.status.condition() == ConditionType.SUCCEEDED
+        if chaos.kills:
+            assert out.status.restart_count >= 1
+    finally:
+        chaos.stop()
+        op.stop()
+        cluster.shutdown()

@@ -279,9 +279,14 @@ def test_render_platform_no_gpu_and_complete():
     for d in docs:
         kinds.setdefault(d["kind"], []).append(d["metadata"]["name"])
     assert "nvidia" not in text.lower()
-    assert len(kinds["CustomResourceDefinition"]) >= 15
-    assert any("training-controller" == n for n in kinds["Deployment"])
+    # only daemon-reconciled kinds get CRDs (no orphaned user objects)
+    assert len(kinds["CustomResourceDefinition"]) == 6
+    # every Deployment's state PVC is actually rendered
+    for dep in kinds["Deployment"]:
+        assert f"{dep}-state" in kinds["PersistentVolumeClaim"]
+    assert any("kft-operator" == n for n in kinds["Deployment"])
     assert any("metadata-store" == n for n in kinds["Deployment"])
+    assert "kft-platform-config" in kinds["ConfigMap"]
     # every deployment has rbac
     for dep in kinds["Deployment"]:
         assert dep in kinds["ServiceAccount"]
@@ -289,16 +294,76 @@ def test_render_platform_no_gpu_and_complete():
 
 def test_manifest_overlays():
     text = render_platform(overlays=[
-        overlay_images({"kubeflow-tpu/controller:latest": "reg.io/ctl:v2"}),
-        overlay_replicas("dashboard", 3),
+        overlay_images({"kubeflow-tpu/platform:latest": "reg.io/kft:v2"}),
+        overlay_replicas("kft-operator", 3),
     ])
     docs = list(yaml.safe_load_all(text))
     deps = {d["metadata"]["name"]: d for d in docs
             if d["kind"] == "Deployment"}
-    img = deps["training-controller"]["spec"]["template"]["spec"][
+    img = deps["kft-operator"]["spec"]["template"]["spec"][
         "containers"][0]["image"]
-    assert img == "reg.io/ctl:v2"
-    assert deps["dashboard"]["spec"]["replicas"] == 3
+    assert img == "reg.io/kft:v2"
+    assert deps["kft-operator"]["spec"]["replicas"] == 3
+
+
+def test_install_path_validated_against_codebase():
+    """The rendered install must reference THIS codebase, not imaginary
+    binaries: the operator Deployment's command resolves to a real module
+    and its args parse with the real CLI parser; the ConfigMap's platform
+    json loads with the real config loader; the Dockerfile builds the
+    image the Deployments reference."""
+    import importlib
+    import os
+
+    from kubeflow_tpu.controller.__main__ import build_parser
+    from kubeflow_tpu.platform.config import load_config
+
+    docs = list(yaml.safe_load_all(render_platform()))
+    deps = {d["metadata"]["name"]: d for d in docs
+            if d["kind"] == "Deployment"}
+    op = deps["kft-operator"]["spec"]["template"]["spec"]["containers"][0]
+    # command: python -m <module> — the module must import
+    assert op["command"][:2] == ["python", "-m"]
+    importlib.import_module(op["command"][2])
+    # args must parse with the REAL argparse surface (no drifted flags)
+    args = build_parser().parse_args(op["args"])
+    assert args.cmd == "serve" and args.config == "/etc/kft/platform.json"
+    # kubelet probes + Services need a non-loopback bind
+    assert args.bind_host == "0.0.0.0" and args.port == 8080
+    assert op["livenessProbe"]["httpGet"]["port"] == 8080
+    # the raw-TCP metadata store must get a socket probe and a Service on
+    # its actual port, never an HTTP probe
+    md = deps["metadata-store"]["spec"]["template"]["spec"]["containers"][0]
+    assert "tcpSocket" in md["livenessProbe"]
+    assert md["ports"][0]["containerPort"] == 8081
+    svc = {d["metadata"]["name"]: d for d in docs if d["kind"] == "Service"}
+    assert svc["metadata-store"]["spec"]["ports"][0]["port"] == 8081
+    # fresh installs must be usable: the shipped auth file has a bootstrap
+    # admin credential (rotate after install), not an empty lockout
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    import json as _json2
+
+    auth_doc = _json2.loads(cm["data"]["auth.json"])
+    assert auth_doc["tokens"] and auth_doc["admins"]
+    # the mounted ConfigMap's platform.json round-trips through load_config
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    import json as _json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        f.write(cm["data"]["platform.json"])
+    cfg = load_config(f.name)
+    assert cfg.state_dir == "/data"
+    os.unlink(f.name)
+    # every Deployment image is produced by the repo's Dockerfile
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dockerfile = open(os.path.join(root, "Dockerfile")).read()
+    for d in deps.values():
+        img = d["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert img.split(":")[0] == "kubeflow-tpu/platform"
+    assert "kubeflow_tpu" in dockerfile
+    assert "metadata_store" in dockerfile
 
 
 def test_tpu_pod_template_contract():

@@ -98,6 +98,24 @@ def test_payload_logger_writes_jsonl(tmp_path):
     logged.unload()
 
 
+def test_mounted_bucket_storage(tmp_path, monkeypatch):
+    """gs:// resolves through the FUSE mounted-bucket convention (no cloud
+    SDK in the image); unmounted buckets fail with an actionable error."""
+    import pytest
+
+    from kubeflow_tpu.serving.storage import download
+
+    root = tmp_path / "gcs-mounts"
+    (root / "my-bucket" / "models" / "llm").mkdir(parents=True)
+    (root / "my-bucket" / "models" / "llm" / "weights.bin").write_text("w")
+    monkeypatch.setenv("KFT_BUCKET_MOUNT_ROOT", str(root))
+
+    out = download("gs://my-bucket/models/llm", str(tmp_path / "dest"))
+    assert out == str(root / "my-bucket" / "models" / "llm")
+    with pytest.raises(RuntimeError, match="not mounted"):
+        download("gs://other-bucket/x", str(tmp_path / "dest2"))
+
+
 def test_model_puller_syncs_config_dir(tmp_path):
     cfg_dir = str(tmp_path / "models-config")
     os.makedirs(cfg_dir)
