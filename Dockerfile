@@ -23,4 +23,5 @@ COPY --from=native-build /src/native/metadata_store/metadata_store \
 ENV PYTHONPATH=/opt/kft
 EXPOSE 8080
 ENTRYPOINT ["python", "-m", "kubeflow_tpu.controller"]
-CMD ["serve", "--config", "/etc/kft/platform.json", "--state-dir", "/data"]
+CMD ["serve", "--config", "/etc/kft/platform.json", "--state-dir", "/data", \
+     "--bind-host", "0.0.0.0"]

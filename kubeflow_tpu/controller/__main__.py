@@ -125,7 +125,7 @@ def main(argv=None) -> int:
     port = op.start(port=args.port, host=args.bind_host)
     if resumed:
         print(f"kft-operator resumed experiments: {resumed}", flush=True)
-    print(f"kft-operator serving on 127.0.0.1:{port}", flush=True)
+    print(f"kft-operator serving on {args.bind_host}:{port}", flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -390,13 +390,8 @@ def _make_http_server(op: Operator, port: int,
                 snap = op.dashboard.snapshot(user)
                 if self.path == "/apis/v1/dashboard":
                     return self._send(200, json.dumps(snap))
-                rows = "".join(
-                    f"<h2>{k}</h2><pre>{json.dumps(v, indent=1)}</pre>"
-                    for k, v in snap.items())
-                return self._send(
-                    200, "<html><title>kubeflow-tpu</title><body>"
-                         f"<h1>kubeflow-tpu dashboard</h1>{rows}"
-                         "</body></html>", "text/html")
+                return self._send(200, op.dashboard.render_html(snap),
+                                  "text/html")
             ns, name = self._job_path()
             if ns and name:
                 job = op.controller.get(ns, name)

@@ -78,6 +78,21 @@ class Dashboard:
             ]
         return out
 
+    @staticmethod
+    def render_html(snap: dict) -> str:
+        """The ONE html renderer (operator route + standalone server).
+        Tenant-chosen names land in this page, so everything is escaped —
+        unescaped interpolation here is stored XSS against whoever views
+        the dashboard."""
+        import html as _html
+
+        rows = "".join(
+            f"<h2>{_html.escape(str(k))}</h2>"
+            f"<pre>{_html.escape(json.dumps(v, indent=1))}</pre>"
+            for k, v in snap.items())
+        return ("<html><title>kubeflow-tpu</title><body>"
+                f"<h1>kubeflow-tpu dashboard</h1>{rows}</body></html>")
+
     def serve(self, host: str = "127.0.0.1", port: int = 0):
         outer = self
 
@@ -92,13 +107,7 @@ class Dashboard:
                     body = json.dumps(outer.snapshot(user)).encode()
                     ctype = "application/json"
                 elif parsed.path in ("/", "/index.html"):
-                    snap = outer.snapshot(user)
-                    rows = "".join(
-                        f"<h2>{k}</h2><pre>{json.dumps(v, indent=1)}</pre>"
-                        for k, v in snap.items())
-                    body = (f"<html><title>kubeflow-tpu</title><body>"
-                            f"<h1>kubeflow-tpu dashboard</h1>{rows}"
-                            f"</body></html>").encode()
+                    body = outer.render_html(outer.snapshot(user)).encode()
                     ctype = "text/html"
                 else:
                     self.send_response(404)

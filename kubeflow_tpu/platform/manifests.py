@@ -53,7 +53,8 @@ CONTROLLERS = [
      8080, "http"),
     ("metadata-store", PLATFORM_IMAGE,
      ["/opt/kft/native/metadata_store"],
-     ["--port", "8081", "--wal", "/data/metadata.wal"],
+     ["--port", "8081", "--wal", "/data/metadata.wal",
+      "--host", "0.0.0.0"],
      8081, "tcp"),
 ]
 
@@ -132,16 +133,20 @@ def deployment(name: str, image: str, args: list[str],
 
 
 def platform_configmap(namespace: str = "kubeflow-tpu",
-                       bootstrap_token: str = "CHANGE-ME-ON-INSTALL") -> dict:
+                       bootstrap_token: Optional[str] = None) -> dict:
     """The ConfigMap tier the operator's --config flag consumes — generated
     from the REAL PlatformConfig defaults so keys can't drift. The auth
-    file ships a bootstrap cluster-admin token (kubeadm-style: rotate it
-    right after install) — an empty token map would lock every API call
-    out of a fresh install."""
+    file ships a bootstrap cluster-admin token (kubeadm-style: random per
+    render, never a shared constant; rotate after install) — an empty
+    token map would lock every API call out of a fresh install."""
     import dataclasses as dc
     import json as _json
+    import secrets
 
     from kubeflow_tpu.platform.config import PlatformConfig
+
+    if bootstrap_token is None:
+        bootstrap_token = "bootstrap-" + secrets.token_hex(16)
 
     return {
         "apiVersion": "v1",

@@ -95,7 +95,13 @@ _BUCKET_MOUNT_ROOTS = {"gs": "/gcs", "s3": "/s3", "azure": "/azure"}
 def _from_mounted_bucket(scheme: str, parsed, dest_dir: str) -> str:
     root = os.environ.get("KFT_BUCKET_MOUNT_ROOT",
                           _BUCKET_MOUNT_ROOTS[scheme])
-    path = os.path.join(root, parsed.netloc, parsed.path.lstrip("/"))
+    path = os.path.normpath(
+        os.path.join(root, parsed.netloc, parsed.path.lstrip("/")))
+    # storage_uri is tenant-supplied: ".." must never escape the mount root
+    # (gs://../etc would otherwise resolve to /etc)
+    if not path.startswith(os.path.normpath(root) + os.sep):
+        raise ValueError(
+            f"storage uri escapes the {scheme} mount root: {path!r}")
     if not os.path.exists(path):
         raise RuntimeError(
             f"{scheme}://{parsed.netloc} is not mounted at {root} (expected "

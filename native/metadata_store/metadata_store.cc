@@ -12,7 +12,7 @@
 // backends are interchangeable on the same WAL file.
 //
 // Build: `make` in this directory (g++ -O2 -std=c++17). Run:
-//   ./metadata_store --port 0 [--wal /path/store.wal]
+//   ./metadata_store --port 0 [--wal /path/store.wal] [--host 0.0.0.0]
 // Prints "LISTENING <port>" on stdout once bound (the launcher handshake).
 
 #include <arpa/inet.h>
@@ -669,10 +669,12 @@ static void serve_client(int fd, Store* store) {
 int main(int argc, char** argv) {
   int port = 0;
   std::string wal;
+  std::string host = "127.0.0.1";   // loopback by default; pods pass --host
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     if (a == "--port" && i + 1 < argc) port = std::atoi(argv[++i]);
     else if (a == "--wal" && i + 1 < argc) wal = argv[++i];
+    else if (a == "--host" && i + 1 < argc) host = argv[++i];
   }
   Store store(wal);
 
@@ -681,7 +683,11 @@ int main(int argc, char** argv) {
   setsockopt(sock, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (host == "0.0.0.0") addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  else if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    std::cerr << "bad --host " << host << "\n";
+    return 1;
+  }
   addr.sin_port = htons((uint16_t)port);
   if (bind(sock, (sockaddr*)&addr, sizeof(addr)) != 0) {
     std::cerr << "bind failed\n";

@@ -127,6 +127,14 @@ def test_dashboard_snapshot_scoped_by_profile():
     assert [j["name"] for j in snap_all["jobs"]] == ["j1", "j2"]
 
 
+def test_dashboard_html_escapes_tenant_names():
+    """Tenant-chosen names must never execute in a viewer's browser."""
+    html = Dashboard.render_html(
+        {"jobs": [{"name": "x</pre><script>alert(1)</script>"}]})
+    assert "<script>" not in html
+    assert "&lt;script&gt;" in html
+
+
 def test_dashboard_http():
     cluster = FakeCluster()
     jobs = JobController(cluster)
@@ -345,6 +353,17 @@ def test_install_path_validated_against_codebase():
 
     auth_doc = _json2.loads(cm["data"]["auth.json"])
     assert auth_doc["tokens"] and auth_doc["admins"]
+    # the bootstrap credential is random per render, never a shared constant
+    from kubeflow_tpu.platform.manifests import platform_configmap
+
+    t1 = next(iter(_json2.loads(
+        platform_configmap()["data"]["auth.json"])["tokens"]))
+    t2 = next(iter(_json2.loads(
+        platform_configmap()["data"]["auth.json"])["tokens"]))
+    assert t1 != t2 and "CHANGE" not in t1
+    # the raw-TCP store binds beyond loopback in-pod (kubelet probes the
+    # pod IP)
+    assert "--host" in md["args"] and "0.0.0.0" in md["args"]
     # the mounted ConfigMap's platform.json round-trips through load_config
     cm = next(d for d in docs if d["kind"] == "ConfigMap")
     import json as _json

@@ -114,6 +114,12 @@ def test_mounted_bucket_storage(tmp_path, monkeypatch):
     assert out == str(root / "my-bucket" / "models" / "llm")
     with pytest.raises(RuntimeError, match="not mounted"):
         download("gs://other-bucket/x", str(tmp_path / "dest2"))
+    # tenant-supplied uri must never traverse out of the mount root
+    (tmp_path / "secret.txt").write_text("s")
+    with pytest.raises(ValueError, match="escapes"):
+        download("gs://../secret.txt", str(tmp_path / "dest3"))
+    with pytest.raises(ValueError, match="escapes"):
+        download("gs://my-bucket/../../secret.txt", str(tmp_path / "dest4"))
 
 
 def test_model_puller_syncs_config_dir(tmp_path):
