@@ -112,6 +112,10 @@ class Operator:
         # optional platform.auth.Auth: bearer-token authn + KFAM authz on
         # every namespaced route (the istio/dex L1 role); None = open
         self.auth = auth
+        if getattr(auth, "profiles", None) is not None:
+            # quota admission registers on the CONTROLLER so every
+            # submission path (HTTP, SDK, HPO trial jobs) is metered
+            controller.admission_checks.append(self._check_quota)
         # optional platform.dashboard.Dashboard: served at /dashboard
         # (HTML) and /apis/v1/dashboard (JSON), user-scoped when auth is on
         self.dashboard = dashboard
@@ -153,6 +157,29 @@ class Operator:
     def _locked(self, fn):
         with self._lock:
             return fn()
+
+    @staticmethod
+    def _job_chips(job) -> int:
+        return sum(
+            spec.replicas * spec.template.tpu.chips_per_host
+            for spec in job.replica_specs.values()
+            if spec.template.tpu is not None)
+
+    def _check_quota(self, job) -> None:
+        """Profile ResourceQuota admission (the quota-webhook role): TPU
+        chips + job count per namespace, enforced before the job exists."""
+        profiles = getattr(self.auth, "profiles", None)
+        if profiles is None:
+            return
+        used_chips = jobs_running = 0
+        for (ns, _), other in self.controller.jobs.items():
+            if ns != job.namespace or other.status.is_finished():
+                continue
+            jobs_running += 1
+            used_chips += self._job_chips(other)
+        profiles.check_quota(
+            job.namespace, tpu_chips=used_chips, jobs_running=jobs_running,
+            new_jobs=1, new_tpu_chips=self._job_chips(job))
 
     def submit(self, job) -> None:
         with self._lock:
@@ -444,7 +471,10 @@ def _make_http_server(op: Operator, port: int,
                     job.namespace = ns
                     op.submit(job)
                 except Exception as e:
-                    return self._send(400, json.dumps({"error": str(e)}))
+                    from kubeflow_tpu.platform.profiles import QuotaExceeded
+
+                    code = 403 if isinstance(e, QuotaExceeded) else 400
+                    return self._send(code, json.dumps({"error": str(e)}))
                 return self._send(201, json.dumps(_job_to_dict(job)))
             ns, _ = self._resource_path("experiments")
             if ns and op.experiments is not None:

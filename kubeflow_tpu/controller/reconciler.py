@@ -54,11 +54,17 @@ class JobController:
         self.metrics: dict[str, float] = {}   # controller-level observability
         # admission hook (PodDefaults registry / webhook equivalent)
         self.pod_mutator = pod_mutator
+        # validating-admission hooks run on EVERY submission path (HTTP,
+        # SDK, HPO trial jobs) — quota enforcement lives here, not in the
+        # HTTP-facing wrapper, so nothing can route around it
+        self.admission_checks: list = []
 
     # ---------------- apiserver-ish surface ----------------
 
     def submit(self, job: JobSpec) -> JobSpec:
         validate(job)
+        for check in self.admission_checks:
+            check(job)
         key = (job.namespace, job.name)
         if key in self.jobs:
             raise KeyError(f"job {key} already exists")

@@ -50,16 +50,17 @@ class Auth:
     def from_file(cls, path: str,
                   profiles: Optional[ProfileController] = None) -> "Auth":
         """JSON: {"tokens": {token: user}, "admins": [user],
-        "profiles": [{"name": ns, "owner": user,
-                      "contributors": [user]}]}."""
+        "profiles": [{"name": ns, "owner": user, "contributors": [user],
+                      "quota": {"tpu_chips": N, "max_jobs": N, ...}}]}."""
         with open(path) as f:
             spec = json.load(f)
         if profiles is None and spec.get("profiles"):
-            from kubeflow_tpu.platform.profiles import Profile
+            from kubeflow_tpu.platform.profiles import Profile, ResourceQuota
 
             profiles = ProfileController()
             for p in spec["profiles"]:
-                prof = Profile(name=p["name"], owner=p["owner"])
+                prof = Profile(name=p["name"], owner=p["owner"],
+                               quota=ResourceQuota(**p.get("quota", {})))
                 profiles.apply(prof)
                 for c in p.get("contributors", []):
                     profiles.add_contributor(p["name"], c)

@@ -160,6 +160,28 @@ def platform_configmap(namespace: str = "kubeflow-tpu",
     }
 
 
+def metadata_store_network_policy(namespace: str = "kubeflow-tpu") -> dict:
+    """The unauthenticated raw-TCP store binds beyond loopback so kubelet
+    can probe it — this policy is what keeps every tenant pod from reading
+    or rewriting cross-namespace lineage/HPO/pipeline state: only the
+    operator may connect."""
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {"name": "metadata-store-operator-only",
+                     "namespace": namespace},
+        "spec": {
+            "podSelector": {"matchLabels": {"app": "metadata-store"}},
+            "policyTypes": ["Ingress"],
+            "ingress": [{
+                "from": [{"podSelector":
+                          {"matchLabels": {"app": "kft-operator"}}}],
+                "ports": [{"protocol": "TCP", "port": 8081}],
+            }],
+        },
+    }
+
+
 def pvc(name: str, namespace: str = "kubeflow-tpu",
         size: str = "10Gi") -> dict:
     return {
@@ -234,6 +256,7 @@ def render_platform(namespace: str = "kubeflow-tpu",
     for plural, kind in CRD_KINDS:
         docs.append(crd(plural, kind))
     docs.append(platform_configmap(namespace))
+    docs.append(metadata_store_network_policy(namespace))
     for name, image, command, args, port, probe in CONTROLLERS:
         docs.extend(rbac(name, namespace))
         docs.append(pvc(f"{name}-state", namespace))

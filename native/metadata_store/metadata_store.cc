@@ -683,8 +683,7 @@ int main(int argc, char** argv) {
   setsockopt(sock, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  if (host == "0.0.0.0") addr.sin_addr.s_addr = htonl(INADDR_ANY);
-  else if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
     std::cerr << "bad --host " << host << "\n";
     return 1;
   }

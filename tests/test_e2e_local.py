@@ -48,6 +48,29 @@ def test_jaxjob_2proc_world(client, tmp_path):
     assert any(r.get("world_ok") == 1.0 for r in recs)
 
 
+def test_jaxjob_multidevice_fsdp_world(client, tmp_path):
+    """Multi-host-shaped world: 2 processes x 2 devices = a 4-device global
+    mesh with FSDP sharding ACROSS process boundaries — the DCN/ICI
+    two-tier layout every real slice job uses, plus real cross-process
+    training steps."""
+    env = base_env(tmp_path)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["KFT_TRAIN_STEPS"] = "3"
+    job = client.create_jax_job(
+        "e2e-fsdp", workers=2, command=WORKER_CMD,
+        mesh={"fsdp": 4}, env=env,
+    )
+    done = client.wait_for_job_conditions("e2e-fsdp", timeout=180)
+    logs = client.get_job_logs("e2e-fsdp", index=0)
+    assert done.status.condition() == ConditionType.SUCCEEDED, logs
+    assert "devices=4" in logs
+    assert "trained to step 3" in logs
+    from kubeflow_tpu.training.metrics import read_metrics
+
+    recs = read_metrics(str(tmp_path / "metrics.jsonl"))
+    assert any("loss" in r for r in recs)
+
+
 def test_jaxjob_failure_restarts_then_fails(client, tmp_path):
     bad_cmd = [sys.executable, "-c", "import sys; sys.exit(1)"]
     client.create_jax_job(

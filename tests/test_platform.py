@@ -241,6 +241,46 @@ def test_auth_from_file(tmp_path):
     assert auth.check("Bearer t2", "POST", "other").status == 403
 
 
+def test_operator_enforces_profile_quota():
+    """ResourceQuota admission at submit: a namespace capped at 16 TPU
+    chips and 2 jobs rejects work past either limit with QuotaExceeded —
+    on EVERY submission path, including HPO trial jobs."""
+    from kubeflow_tpu.api.types import TPUSpec, jax_job
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+    from kubeflow_tpu.platform.auth import Auth
+    from kubeflow_tpu.platform.profiles import (
+        Profile, ProfileController, QuotaExceeded, ResourceQuota,
+    )
+
+    profiles = ProfileController()
+    profiles.apply(Profile(name="capped", owner="a@x.io",
+                           quota=ResourceQuota(tpu_chips=16, max_jobs=2)))
+    auth = Auth(tokens={"t": "a@x.io"}, profiles=profiles)
+    op = Operator(JobController(FakeCluster()), auth=auth)
+
+    # 32 chips > the 16-chip quota
+    big = jax_job("big", workers=8, tpu=TPUSpec("v5e", "4x4"),
+                  namespace="capped")
+    with pytest.raises(QuotaExceeded, match="chip quota"):
+        op.submit(big)
+    # two 4-chip jobs fit; the third trips max_jobs
+    for i in range(2):
+        op.submit(jax_job(f"ok-{i}", workers=1, tpu=TPUSpec("v5e", "2x2"),
+                          namespace="capped"))
+    with pytest.raises(QuotaExceeded, match="job quota"):
+        op.submit(jax_job("third", workers=1, tpu=TPUSpec("v5e", "2x2"),
+                          namespace="capped"))
+    # other namespaces (no profile) stay unmetered
+    op.submit(jax_job("free", workers=8, tpu=TPUSpec("v5e", "4x4"),
+                      namespace="other"))
+    # the check guards the CONTROLLER, so trial-job-style direct submission
+    # cannot route around it either (review finding)
+    with pytest.raises(QuotaExceeded):
+        op.controller.submit(jax_job(
+            "sneaky-trial", workers=1, tpu=TPUSpec("v5e", "2x2"),
+            namespace="capped"))
+
+
 def test_operator_http_enforces_auth():
     """The L1 boundary on the live API: 401 without a token, 403 for a
     viewer's writes, 201 for the namespace owner, /healthz open."""
@@ -362,8 +402,11 @@ def test_install_path_validated_against_codebase():
         platform_configmap()["data"]["auth.json"])["tokens"]))
     assert t1 != t2 and "CHANGE" not in t1
     # the raw-TCP store binds beyond loopback in-pod (kubelet probes the
-    # pod IP)
+    # pod IP) — and the unauthenticated socket is fenced to the operator
     assert "--host" in md["args"] and "0.0.0.0" in md["args"]
+    netpol = [d for d in docs if d["kind"] == "NetworkPolicy"]
+    assert netpol and netpol[0]["spec"]["podSelector"]["matchLabels"][
+        "app"] == "metadata-store"
     # the mounted ConfigMap's platform.json round-trips through load_config
     cm = next(d for d in docs if d["kind"] == "ConfigMap")
     import json as _json
