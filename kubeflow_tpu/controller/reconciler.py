@@ -63,11 +63,13 @@ class JobController:
 
     def submit(self, job: JobSpec) -> JobSpec:
         validate(job)
-        for check in self.admission_checks:
-            check(job)
         key = (job.namespace, job.name)
+        # existence before quota: a retried POST for a job that already
+        # exists must report the collision, not a misleading 403
         if key in self.jobs:
             raise KeyError(f"job {key} already exists")
+        for check in self.admission_checks:
+            check(job)
         job.uid = job.uid or uuid.uuid4().hex[:12]
         job.status = JobStatus()
         self._set_condition(job, ConditionType.CREATED, "JobCreated")

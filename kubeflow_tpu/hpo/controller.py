@@ -147,7 +147,19 @@ class JobTrialRunner(TrialRunner):
         job.labels["experiment"] = experiment.name
         for spec in job.replica_specs.values():
             spec.template.env["KFT_METRICS_PATH"] = self.metrics_path(trial.name)
-        self.jobs.submit(job)
+        try:
+            self.jobs.submit(job)
+        except Exception as e:
+            # admission rejection (quota, validation): the trial FAILS —
+            # a CREATED trial nothing ever polls would wedge the experiment
+            # forever while silently eating parallelism budget
+            trial.state = TrialState.FAILED
+            trial.completion_time = time.time()
+            trial.observations.append(Observation(
+                metric_name="admission_error", value=0.0))
+            print(f"trial {trial.name}: submission rejected: {e}",
+                  flush=True)
+            return
         self.jobs.reconcile(job.namespace, job.name)
         trial.state = TrialState.RUNNING
 

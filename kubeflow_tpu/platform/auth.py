@@ -55,12 +55,22 @@ class Auth:
         with open(path) as f:
             spec = json.load(f)
         if profiles is None and spec.get("profiles"):
+            import dataclasses as _dc
+
             from kubeflow_tpu.platform.profiles import Profile, ResourceQuota
 
+            quota_keys = {f.name for f in _dc.fields(ResourceQuota)}
             profiles = ProfileController()
             for p in spec["profiles"]:
+                quota = p.get("quota", {})
+                unknown = set(quota) - quota_keys
+                if unknown:
+                    raise ValueError(
+                        f"profile {p['name']!r} in {path}: unknown quota "
+                        f"keys {sorted(unknown)}; known: "
+                        f"{sorted(quota_keys)}")
                 prof = Profile(name=p["name"], owner=p["owner"],
-                               quota=ResourceQuota(**p.get("quota", {})))
+                               quota=ResourceQuota(**quota))
                 profiles.apply(prof)
                 for c in p.get("contributors", []):
                     profiles.add_contributor(p["name"], c)

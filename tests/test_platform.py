@@ -279,6 +279,71 @@ def test_operator_enforces_profile_quota():
         op.controller.submit(jax_job(
             "sneaky-trial", workers=1, tpu=TPUSpec("v5e", "2x2"),
             namespace="capped"))
+    # retried POST of an EXISTING job reports the collision, not quota
+    with pytest.raises(KeyError, match="already exists"):
+        op.controller.submit(jax_job(
+            "ok-0", workers=1, tpu=TPUSpec("v5e", "2x2"),
+            namespace="capped"))
+
+
+def test_over_quota_trials_fail_instead_of_wedging(tmp_path):
+    """An HPO sweep whose trials exceed quota must FAIL trials (and then
+    the experiment via the failed-trial budget) — a rejected trial left
+    CREATED would silently consume parallelism forever."""
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+    from kubeflow_tpu.hpo.controller import ExperimentController, JobTrialRunner
+    from kubeflow_tpu.hpo.types import (
+        AlgorithmSpec, Experiment, ObjectiveSpec, ParameterSpec,
+        ParameterType, TrialState,
+    )
+    from kubeflow_tpu.api.types import TPUSpec, jax_job
+    from kubeflow_tpu.platform.auth import Auth
+    from kubeflow_tpu.platform.profiles import (
+        Profile, ProfileController, ResourceQuota,
+    )
+
+    profiles = ProfileController()
+    profiles.apply(Profile(name="capped", owner="a@x.io",
+                           quota=ResourceQuota(tpu_chips=4)))
+    jobs = JobController(FakeCluster())
+    Operator(jobs, auth=Auth(tokens={}, profiles=profiles))   # wires check
+
+    def template(trial_name, params):
+        # every trial wants 16 chips in a 4-chip namespace
+        return jax_job(trial_name, workers=4, tpu=TPUSpec("v5e", "4x4"))
+
+    exp = Experiment(
+        name="doomed", namespace="capped",
+        parameters=[ParameterSpec(name="x", type=ParameterType.DOUBLE,
+                                  min=0.0, max=1.0)],
+        objective=ObjectiveSpec(metric_name="loss"),
+        algorithm=AlgorithmSpec(name="random"),
+        max_trial_count=6, parallel_trial_count=2,
+        max_failed_trial_count=2,
+    )
+    ctl = ExperimentController(
+        exp, JobTrialRunner(jobs, template, metrics_dir=str(tmp_path)))
+    for _ in range(10):
+        ctl.step()
+        if exp.failed:
+            break
+    assert exp.failed
+    assert exp.completion_reason == "MaxFailedTrialCountExceeded"
+    assert all(t.state == TrialState.FAILED for t in exp.trials)
+
+
+def test_auth_file_rejects_unknown_quota_keys(tmp_path):
+    import json as _json
+
+    from kubeflow_tpu.platform.auth import Auth
+
+    path = tmp_path / "auth.json"
+    path.write_text(_json.dumps({
+        "tokens": {"t": "a@x.io"},
+        "profiles": [{"name": "p", "owner": "a@x.io",
+                      "quota": {"tpu-chips": 16}}]}))
+    with pytest.raises(ValueError, match="unknown quota keys"):
+        Auth.from_file(str(path))
 
 
 def test_operator_http_enforces_auth():
