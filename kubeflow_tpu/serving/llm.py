@@ -160,6 +160,10 @@ class LLMEngine:
                 p, toks, cfg, cache, lengths=lens))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._set_len = jax.jit(
+            lambda cache, length, slot: {
+                **cache, "len": cache["len"].at[slot].set(length)},
+            donate_argnums=(0,))
 
     # ---------------- jitted bodies ----------------
 
@@ -321,12 +325,16 @@ class LLMEngine:
                 slot = self._free.pop()
             # reserve the blocks this request can ever touch; when the pool
             # is exhausted the request waits at the HEAD of the queue (FIFO
-            # under memory pressure — later arrivals must not starve it)
+            # under memory pressure — later arrivals must not starve it).
+            # Full prompt blocks already cached (same tokens, same
+            # positions) are SHARED, not recomputed storage.
             bs = self.paged.block_size
             nb_prefill = blocks_for(len(req.prompt), bs)
-            if not self.paged.reserve(slot, len(req.prompt),
-                                      req.sampling.max_tokens,
-                                      min_blocks=nb_prefill):
+            n_shared = self.paged.reserve(slot, len(req.prompt),
+                                          req.sampling.max_tokens,
+                                          min_blocks=nb_prefill,
+                                          prompt=req.prompt)
+            if n_shared is None:
                 with self._lock:
                     self._waiting.insert(0, req)
                 self._free.append(slot)
@@ -345,14 +353,20 @@ class LLMEngine:
                 jnp.asarray([req.sampling.top_k], jnp.int32),
                 jnp.asarray([req.sampling.top_p], jnp.float32))
             first_tok = int(np.asarray(first)[0])
-            # only the blocks covering the true prompt length are written
-            # (pad rows past them were never attended and never will be)
-            blk_ids = self.paged.slot_blocks(slot)[:nb_prefill]
-            self.cache = self._insert(
-                self.cache, filled["k"][:, :, :nb_prefill * bs],
-                filled["v"][:, :, :nb_prefill * bs],
-                jnp.asarray(blk_ids, jnp.int32),
-                jnp.int32(len(req.prompt)), jnp.int32(slot))
+            # write only the blocks covering the true prompt length (pad
+            # rows past them are never attended), and within those skip the
+            # shared prefix blocks — their identical KV is already resident
+            blk_ids = self.paged.slot_blocks(slot)[n_shared:nb_prefill]
+            if blk_ids:
+                self.cache = self._insert(
+                    self.cache,
+                    filled["k"][:, :, n_shared * bs:nb_prefill * bs],
+                    filled["v"][:, :, n_shared * bs:nb_prefill * bs],
+                    jnp.asarray(blk_ids, jnp.int32),
+                    jnp.int32(len(req.prompt)), jnp.int32(slot))
+            else:
+                self.cache = self._set_len(
+                    self.cache, jnp.int32(len(req.prompt)), jnp.int32(slot))
             # the prefill-sampled token is generation token #1; decode
             # continues from it
             req.generated.append(first_tok)

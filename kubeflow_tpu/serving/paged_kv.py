@@ -82,13 +82,20 @@ def blocks_for(tokens: int, block_size: int) -> int:
 
 @dataclasses.dataclass
 class PagedKV:
-    """The engine-facing bundle: pool dict + host block tables/allocator."""
+    """The engine-facing bundle: pool dict + host block tables/allocator,
+    with automatic prefix caching (the vLLM APC role): full prompt blocks
+    are content-hashed (position-chained, so only identical prefixes at
+    identical positions match) and shared across requests by refcount.
+    Shared blocks are never rewritten — the KV inside is a pure function
+    of (tokens, positions, params). When a block's refcount hits zero it
+    stays cached and evictable (LRU) until the pool needs it back."""
 
     cfg: llama.LlamaConfig
     max_batch: int
     max_seq: int
     block_size: int
     num_blocks: int
+    prefix_cache: bool = True
 
     def __post_init__(self):
         self.cache = init_paged_cache(
@@ -99,31 +106,124 @@ class PagedKV:
             (self.max_batch, self.max_blocks_per_seq), np.int32)
         self.allocator = BlockAllocator(self.num_blocks)
         self._slot_blocks: dict[int, list[int]] = {}
+        # prefix cache state
+        self._ref: dict[int, int] = {}              # block -> live users
+        self._block_of_hash: dict[str, int] = {}    # insertion order = LRU
+        self._hash_of_block: dict[int, str] = {}
+        self.prefix_hits = 0                        # observability
+
+    # ---- prefix hashing ----
+
+    def _prefix_hashes(self, prompt) -> list[str]:
+        """One chained hash per FULL prompt block (position-dependence is
+        implied by the chain: block k's hash folds in blocks 0..k-1)."""
+        import hashlib
+
+        out, h = [], hashlib.sha256()
+        n_full = len(prompt) // self.block_size
+        for k in range(n_full):
+            chunk = prompt[k * self.block_size:(k + 1) * self.block_size]
+            h.update((",".join(map(str, chunk)) + ";").encode())
+            out.append(h.hexdigest()[:24])
+        return out
+
+    def _register_hash(self, hsh: str, blk: int) -> None:
+        """Point ``hsh`` at ``blk``, fully unlinking any stale mapping: a
+        partially-evicted chain can leave hsh -> old_blk behind, and
+        overwriting only one direction would orphan old_blk forever
+        (release() skips cached blocks; eviction iterates hashes)."""
+        old = self._block_of_hash.get(hsh)
+        if old is not None and old != blk:
+            self._hash_of_block.pop(old, None)
+            if self._ref.get(old, 0) == 0:
+                self.allocator.free([old])
+        self._block_of_hash[hsh] = blk
+        self._hash_of_block[blk] = hsh
+
+    def _alloc_evicting(self, n: int):
+        """Allocator alloc with LRU eviction of unreferenced cached blocks."""
+        ids = self.allocator.alloc(n)
+        if ids is not None:
+            return ids
+        for hsh in list(self._block_of_hash):
+            if self.allocator.free_blocks >= n:
+                break
+            blk = self._block_of_hash[hsh]
+            if self._ref.get(blk, 0) == 0:
+                del self._block_of_hash[hsh]
+                del self._hash_of_block[blk]
+                self.allocator.free([blk])
+        return self.allocator.alloc(n)
 
     # ---- host-side scheduling ----
 
     def reserve(self, slot: int, prompt_len: int, max_tokens: int,
-                min_blocks: int = 0) -> bool:
+                min_blocks: int = 0, prompt=None) -> Optional[int]:
         """Reserve every block the request can ever touch (prompt + all
         generated tokens) so decode never exhausts the pool mid-flight.
-        ``min_blocks`` lets prefill demand bucket-coverage."""
+        With ``prompt`` tokens and prefix caching on, the longest cached
+        block-aligned prefix is SHARED (refcounted) instead of reallocated.
+        Returns the number of shared prefix blocks, or None if the pool
+        cannot satisfy the reservation. ``min_blocks`` lets prefill demand
+        bucket-coverage."""
         need = max(blocks_for(prompt_len + max_tokens, self.block_size),
                    min_blocks)
         need = min(need, self.max_blocks_per_seq)
-        ids = self.allocator.alloc(need)
-        if ids is None:
-            return False
+        shared: list[int] = []
+        hashes: list[str] = []
+        if self.prefix_cache and prompt is not None:
+            hashes = self._prefix_hashes(prompt)
+            for hsh in hashes:
+                blk = self._block_of_hash.get(hsh)
+                if blk is None:
+                    break
+                shared.append(blk)
+                # refcount BEFORE any allocation below: eviction skips
+                # referenced blocks, so the allocator can never hand a
+                # shared block back out as someone's private block
+                self._ref[blk] = self._ref.get(blk, 0) + 1
+                # LRU touch
+                self._block_of_hash.pop(hsh)
+                self._block_of_hash[hsh] = blk
+        private = self._alloc_evicting(need - len(shared))
+        if private is None:
+            for blk in shared:          # roll the refcounts back
+                self._ref[blk] -= 1
+                if self._ref[blk] <= 0:
+                    self._ref.pop(blk, None)
+            return None
+        self.prefix_hits += len(shared)
+        # private blocks holding FULL prompt blocks become cacheable: after
+        # prefill-insert they contain exactly the hashed content
+        for k, hsh in enumerate(hashes[len(shared):], start=len(shared)):
+            blk = private[k - len(shared)]
+            self._register_hash(hsh, blk)
+        for blk in private:
+            self._ref[blk] = self._ref.get(blk, 0) + 1
+        ids = shared + private
         self._slot_blocks[slot] = ids
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
         row[:len(ids)] = ids
         self.tables[slot] = row
-        return True
+        return len(shared)
 
     def release(self, slot: int) -> None:
         ids = self._slot_blocks.pop(slot, None)
-        if ids:
-            self.allocator.free(ids)
+        for blk in ids or []:
+            self._ref[blk] = self._ref.get(blk, 1) - 1
+            if self._ref[blk] <= 0:
+                self._ref.pop(blk, None)
+                if blk in self._hash_of_block:
+                    continue    # stays cached + evictable, not free-listed
+                self.allocator.free([blk])
         self.tables[slot] = 0
+
+    @property
+    def reclaimable_blocks(self) -> int:
+        """Free-list blocks plus cached blocks nothing references."""
+        cached_idle = sum(1 for b in self._hash_of_block
+                          if self._ref.get(b, 0) == 0)
+        return self.allocator.free_blocks + cached_idle
 
     def slot_blocks(self, slot: int) -> list[int]:
         return list(self._slot_blocks.get(slot, []))

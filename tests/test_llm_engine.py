@@ -112,6 +112,103 @@ def test_paged_kv_impossible_reservation_fails_fast(tiny):
     assert len(r.generated) == 4
 
 
+def test_prefix_cache_shares_blocks_and_stays_exact(tiny):
+    """vLLM-APC role: two requests with the same 16-token (2-block) prefix
+    share those blocks — fewer pool blocks in flight — and decode output is
+    unchanged versus an engine with the cache disabled."""
+    cfg, params = tiny
+    common = list(range(10, 26))                  # 16 tokens = 2 full blocks
+    prompts = [common + [30], common + [40]]
+
+    def run(prefix_cache):
+        eng = LLMEngine(params, cfg, max_batch=4, max_seq=64,
+                        prefill_buckets=(32,),
+                        kv_block_size=8, kv_num_blocks=33)
+        eng.paged.prefix_cache = prefix_cache
+        reqs = [eng.add_request(p, SamplingParams(max_tokens=10))
+                for p in prompts]
+        eng.step()                                # admit both
+        in_flight = eng.paged.allocator.free_blocks
+        while eng.has_work():
+            eng.step()
+        return eng, reqs, in_flight
+
+    eng_on, reqs_on, free_on = run(True)
+    eng_off, reqs_off, free_off = run(False)
+    # sharing leaves more of the pool free while both are resident
+    assert free_on > free_off
+    assert eng_on.paged.prefix_hits == 2          # request 2 reused 2 blocks
+    for a, b in zip(reqs_on, reqs_off):
+        assert a.generated == b.generated
+        assert_greedy_consistent(params, cfg, a.prompt, a.generated)
+
+
+def test_prefix_cache_eviction_reclaims_idle_blocks(tiny):
+    """Cached blocks of finished requests are evictable: a workload that
+    needs the whole pool still runs after the cache has filled."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(16,),
+                    kv_block_size=8, kv_num_blocks=9)    # 8 usable
+    # distinct 2-full-block prompts, run sequentially: each leaves 2 cached
+    # blocks behind; the third+ need eviction to fit
+    for i in range(4):
+        p = [100 + 16 * i + j for j in range(16)]
+        r = eng.generate([p], SamplingParams(max_tokens=4))[0]
+        assert len(r.generated) == 4
+    # everything is reclaimable once idle (free list + idle cached blocks)
+    assert eng.paged.reclaimable_blocks == 8
+
+
+def _paged(tiny_cfg, num_blocks, bs=8, max_seq=64):
+    from kubeflow_tpu.serving.paged_kv import PagedKV
+
+    return PagedKV(cfg=tiny_cfg, max_batch=4, max_seq=max_seq,
+                   block_size=bs, num_blocks=num_blocks)
+
+
+def test_prefix_cache_never_evicts_in_flight_shared_blocks(tiny):
+    """Review repro: a reservation whose shared prefix blocks are the only
+    eviction candidates must FAIL (pool too small), never evict-and-reuse
+    a block it itself shares (which duplicated the block in the table)."""
+    cfg, _ = tiny
+    kv = _paged(cfg, num_blocks=6)               # 5 usable
+    prompt = list(range(16))                      # 2 full blocks
+    assert kv.reserve(0, 16, 8, prompt=prompt) == 0      # blocks for A
+    kv.release(0)                                 # 2 cached idle
+    # B shares 2 and needs 4 more distinct = 6 > 5 usable: must refuse
+    out = kv.reserve(1, 16, 32, prompt=prompt)
+    assert out is None
+    assert kv.slot_blocks(1) == []
+    # and the rollback left the cached blocks reusable
+    assert kv.reserve(2, 16, 8, prompt=prompt) == 2      # now shares fine
+    ids = kv.slot_blocks(2)
+    assert len(ids) == len(set(ids))              # no duplicates, ever
+
+
+def test_prefix_cache_partial_eviction_leaks_no_blocks(tiny):
+    """Review repro: evicting only the head of a hash chain, then
+    re-registering the same chain, must not orphan the surviving tail
+    block (unreachable by both release() and the eviction loop)."""
+    cfg, _ = tiny
+    usable = 3
+    kv = _paged(cfg, num_blocks=usable + 1)
+    prompt_a = list(range(16))                    # chain h1,h2
+    assert kv.reserve(0, 16, 8, prompt=prompt_a) == 0
+    kv.release(0)                                 # h1,h2 cached idle
+    # unrelated request forces eviction of exactly the LRU head (h1)
+    assert kv.reserve(1, 8, 8, prompt=list(range(50, 58))) is not None
+    kv.release(1)
+    # same chain again: h1 misses, h2's stale mapping must be unlinked
+    assert kv.reserve(2, 16, 8, prompt=prompt_a) is not None
+    kv.release(2)
+    # nothing leaked: every usable block is reclaimable and a full-pool
+    # reservation still succeeds
+    assert kv.reclaimable_blocks == usable
+    assert kv.reserve(3, 8, 16, prompt=list(range(80, 88))) is not None
+    assert len(set(kv.slot_blocks(3))) == len(kv.slot_blocks(3))
+
+
 def test_engine_request_churn(tiny):
     """More requests than slots: slots must be recycled between steps."""
     cfg, params = tiny
