@@ -14,7 +14,7 @@ RUN make -C /src/native/metadata_store
 FROM python:3.12-slim
 # the data plane: jax + the training/serving libraries the workers import
 RUN pip install --no-cache-dir \
-    "jax[cpu]" flax optax orbax-checkpoint chex einops numpy
+    "jax[cpu]" flax optax orbax-checkpoint chex einops numpy cryptography
 WORKDIR /opt/kft
 COPY kubeflow_tpu /opt/kft/kubeflow_tpu
 COPY examples /opt/kft/examples

@@ -39,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--auth-tokens", default=None,
                        help="JSON file with bearer tokens + profile "
                             "bindings; omit for an open (dev) API")
+    serve.add_argument("--tls-dir", default=None,
+                       help="serve the API over HTTPS; a self-signed pair "
+                            "is bootstrapped here if absent (drop real PKI "
+                            "cert.pem/key.pem in to replace it)")
     return parser
 
 
@@ -122,7 +126,23 @@ def main(argv=None) -> int:
         auth=auth,
         dashboard=dashboard,
     )
-    port = op.start(port=args.port, host=args.bind_host)
+    tls_cert = tls_key = None
+    if args.tls_dir:
+        import ipaddress
+
+        from kubeflow_tpu.platform.certs import ensure_self_signed
+
+        hostnames, ips = ["localhost"], ["127.0.0.1", "0.0.0.0"]
+        try:
+            ipaddress.ip_address(args.bind_host)
+            if args.bind_host not in ips:
+                ips.append(args.bind_host)
+        except ValueError:
+            hostnames.append(args.bind_host)
+        tls_cert, tls_key = ensure_self_signed(
+            args.tls_dir, hostnames=hostnames, ip_sans=ips)
+    port = op.start(port=args.port, host=args.bind_host,
+                    tls_cert=tls_cert, tls_key=tls_key)
     if resumed:
         print(f"kft-operator resumed experiments: {resumed}", flush=True)
     print(f"kft-operator serving on {args.bind_host}:{port}", flush=True)

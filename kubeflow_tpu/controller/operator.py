@@ -274,10 +274,14 @@ class Operator:
 
     # ---------------- lifecycle ----------------
 
-    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+    def start(self, port: int = 0, host: str = "127.0.0.1",
+              tls_cert: Optional[str] = None,
+              tls_key: Optional[str] = None) -> int:
         """Start loops + HTTP server; returns the bound port. In-cluster
         deployments pass host="0.0.0.0" so kubelet probes and Services can
-        reach the API; the default stays loopback for local dev."""
+        reach the API; the default stays loopback for local dev. With
+        ``tls_cert``/``tls_key`` the API serves HTTPS (the cert-manager
+        serving-cert role; see platform.certs.ensure_self_signed)."""
         self._threads = [
             threading.Thread(target=self._reconcile_loop, daemon=True,
                              name="kft-reconcile"),
@@ -291,6 +295,13 @@ class Operator:
         for t in self._threads:
             t.start()
         self._httpd = _make_http_server(self, port, host)
+        if tls_cert and tls_key:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
                          name="kft-http").start()

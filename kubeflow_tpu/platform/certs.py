@@ -1,0 +1,91 @@
+"""TLS bootstrap — the cert-manager role (SURVEY.md §1 L1), minimized.
+
+The reference's L1 runs cert-manager to issue serving certificates for
+webhooks and ingress. The single-binary equivalent: the operator
+self-bootstraps a self-signed serving certificate into its state
+directory on first boot (``--tls-dir``) and serves its API over HTTPS;
+clients pin the generated cert (it is its own CA). Swapping in real
+PKI = dropping an issued cert.pem/key.pem into the same directory.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import Sequence
+
+
+def ensure_self_signed(
+    tls_dir: str,
+    common_name: str = "kft-operator",
+    hostnames: Sequence[str] = ("localhost",),
+    ip_sans: Sequence[str] = ("127.0.0.1", "0.0.0.0"),
+    days: int = 365,
+) -> tuple[str, str]:
+    """Return (cert_path, key_path), generating a self-signed pair if the
+    directory doesn't already hold one (idempotent across restarts)."""
+    os.makedirs(tls_dir, exist_ok=True)
+    cert_path = os.path.join(tls_dir, "cert.pem")
+    key_path = os.path.join(tls_dir, "key.pem")
+    if os.path.exists(cert_path) and os.path.exists(key_path):
+        if _sans_cover(cert_path, hostnames, ip_sans):
+            return cert_path, key_path
+        # a rescheduled pod / changed bind host needs new SANs — silently
+        # reusing the old cert would fail every pinning client's hostname
+        # check with no hint
+        print(f"certs: regenerating {cert_path}: existing SANs do not "
+              f"cover {list(hostnames)} + {list(ip_sans)}", flush=True)
+        os.unlink(cert_path)
+        os.unlink(key_path)
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    sans = [x509.DNSName(h) for h in hostnames]
+    sans += [x509.IPAddress(ipaddress.ip_address(ip)) for ip in ip_sans]
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .add_extension(
+            x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    # 0600 from birth: never a window where the key is world-readable
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_path, key_path
+
+
+def _sans_cover(cert_path: str, hostnames: Sequence[str],
+                ip_sans: Sequence[str]) -> bool:
+    try:
+        from cryptography import x509
+
+        with open(cert_path, "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
+        ext = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        have_dns = set(ext.get_values_for_type(x509.DNSName))
+        have_ips = {str(ip) for ip in ext.get_values_for_type(x509.IPAddress)}
+    except Exception:
+        return False
+    return set(hostnames) <= have_dns and set(ip_sans) <= have_ips

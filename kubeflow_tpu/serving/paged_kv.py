@@ -141,10 +141,17 @@ class PagedKV:
         self._hash_of_block[blk] = hsh
 
     def _alloc_evicting(self, n: int):
-        """Allocator alloc with LRU eviction of unreferenced cached blocks."""
+        """Allocator alloc with LRU eviction of unreferenced cached blocks.
+        A doomed allocation (free + idle-cached < n) returns None WITHOUT
+        evicting: a head-of-line request retrying every step must not
+        flush everyone else's prefix cache for nothing."""
         ids = self.allocator.alloc(n)
         if ids is not None:
             return ids
+        idle_cached = sum(1 for b in self._hash_of_block
+                          if self._ref.get(b, 0) == 0)
+        if self.allocator.free_blocks + idle_cached < n:
+            return None
         for hsh in list(self._block_of_hash):
             if self.allocator.free_blocks >= n:
                 break

@@ -186,6 +186,20 @@ def test_prefix_cache_never_evicts_in_flight_shared_blocks(tiny):
     assert len(ids) == len(set(ids))              # no duplicates, ever
 
 
+def test_doomed_reservation_does_not_flush_cache(tiny):
+    """A reservation that can NEVER fit (free + idle-cached < need) must
+    refuse without evicting — a head-of-line retry every step would
+    otherwise flush everyone's prefix cache for nothing."""
+    cfg, _ = tiny
+    kv = _paged(cfg, num_blocks=5)               # 4 usable
+    assert kv.reserve(0, 16, 8, prompt=list(range(16))) == 0  # 3 blocks
+    kv.release(0)                                 # 2 cached idle, 3 free...
+    cached_before = dict(kv._block_of_hash)
+    # needs 8 > 4 usable: doomed — capped at max_blocks_per_seq 8
+    assert kv.reserve(1, 40, 24, prompt=list(range(200, 240))) is None
+    assert kv._block_of_hash == cached_before     # cache untouched
+
+
 def test_prefix_cache_partial_eviction_leaks_no_blocks(tiny):
     """Review repro: evicting only the head of a hash chain, then
     re-registering the same chain, must not orphan the surviving tail

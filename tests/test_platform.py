@@ -153,6 +153,56 @@ def test_dashboard_http():
         server.shutdown()
 
 
+# ------------------------------------------------------------------- tls
+
+def test_operator_serves_https_with_bootstrapped_cert(tmp_path):
+    """The cert-manager role: the operator bootstraps a self-signed pair
+    (idempotent across restarts) and serves HTTPS; clients pin the cert."""
+    import ssl
+    import urllib.request
+
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+    from kubeflow_tpu.platform.certs import ensure_self_signed
+
+    tls_dir = str(tmp_path / "tls")
+    cert, key = ensure_self_signed(tls_dir)
+    cert2, _ = ensure_self_signed(tls_dir)            # idempotent reload
+    assert cert2 == cert
+    assert open(cert).read().startswith("-----BEGIN CERTIFICATE-----")
+
+    op = Operator(JobController(FakeCluster()))
+    port = op.start(port=0, tls_cert=cert, tls_key=key)
+    try:
+        ctx = ssl.create_default_context(cafile=cert)   # pin: cert is its CA
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/healthz", context=ctx,
+                timeout=5) as r:
+            assert r.read() == b"ok"
+        # plaintext against the TLS port must fail
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5)
+    finally:
+        op.stop()
+
+
+def test_cert_regenerated_when_sans_change(tmp_path):
+    from kubeflow_tpu.platform.certs import ensure_self_signed
+
+    tls_dir = str(tmp_path / "tls")
+    cert1, _ = ensure_self_signed(tls_dir, ip_sans=("127.0.0.1",))
+    pem1 = open(cert1).read()
+    # same SANs: stable
+    ensure_self_signed(tls_dir, ip_sans=("127.0.0.1",))
+    assert open(cert1).read() == pem1
+    # pod rescheduled with a new IP: cert must regrow the SAN, not strand
+    # pinning clients on CERTIFICATE_VERIFY_FAILED
+    cert2, _ = ensure_self_signed(tls_dir, ip_sans=("127.0.0.1", "10.0.0.9"))
+    assert open(cert2).read() != pem1
+    import ssl
+    ssl.create_default_context(cafile=cert2)      # still a valid pem
+
+
 # ---------------------------------------------------------------- manifests
 
 # ---------------------------------------------------------------- config
