@@ -300,8 +300,13 @@ class Operator:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert, tls_key)
+            # lazy handshake: accept() must never block the accept loop on
+            # a client that connects and sends nothing (TCP healthchecks,
+            # scanners) — the handshake runs in the per-connection handler
+            # thread on first read instead
             self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True)
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
                          name="kft-http").start()

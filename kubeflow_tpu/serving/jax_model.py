@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -181,6 +182,19 @@ class LLMModel(Model):
             with self._wake:
                 self._wake.notify_all()
 
+    def _sampling(self, p: dict) -> SamplingParams:
+        """ONE place request parameters become SamplingParams — predict and
+        the streaming path must never drift on defaults."""
+        eos_default = (self.tokenizer.eos_id
+                       if self.tokenizer is not None else None)
+        return SamplingParams(
+            max_tokens=int(p.get("max_tokens", 64)),
+            temperature=float(p.get("temperature", 0.0)),
+            top_k=int(p.get("top_k", 0)),
+            top_p=float(p.get("top_p", 1.0)),
+            eos_id=(int(p["eos_id"]) if "eos_id" in p else eos_default),
+        )
+
     def predict(self, request: InferRequest) -> InferResponse:
         arr = request.as_numpy()
         p = request.parameters
@@ -188,15 +202,7 @@ class LLMModel(Model):
         if text_in and self.tokenizer is None:
             raise ValueError(
                 f"model {self.name!r} has no tokenizer; send token ids")
-        eos_default = (self.tokenizer.eos_id
-                       if self.tokenizer is not None else None)
-        sampling = SamplingParams(
-            max_tokens=int(p.get("max_tokens", 64)),
-            temperature=float(p.get("temperature", 0.0)),
-            top_k=int(p.get("top_k", 0)),
-            top_p=float(p.get("top_p", 1.0)),
-            eos_id=(int(p["eos_id"]) if "eos_id" in p else eos_default),
-        )
+        sampling = self._sampling(p)
         if text_in:
             texts = [str(t) for t in arr.reshape(-1)]
             prompts = [self.tokenizer.encode(t, bos=True) for t in texts]
@@ -243,3 +249,76 @@ class LLMModel(Model):
         outputs["tokens"] = tokens
         outputs["lengths"] = lengths
         return InferResponse.from_numpy(self.name, outputs, id=request.id)
+
+    def generate_stream(self, inputs, parameters: Optional[dict] = None):
+        """Incremental generation (the SSE data plane): returns an iterator
+        of ``{"tokens": [...], "text_delta": str?}`` chunks as the engine
+        decodes (chunk granularity = engine decode_chunk), then a final
+        ``{"done": True, "finish_reason": ..., "length": N}``. Closing the
+        iterator aborts the request and frees its slot.
+
+        NOT itself a generator: validation and enqueue happen EAGERLY so a
+        bad request raises here — before the transport commits to a 200 —
+        instead of on the first next()."""
+        p = parameters or {}
+        if isinstance(inputs, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    f"model {self.name!r} has no tokenizer; send token ids")
+            prompt = self.tokenizer.encode(inputs, bos=True)
+            text_out = True
+        else:
+            prompt = [int(t) for t in inputs]
+            text_out = self.tokenizer is not None
+        sampling = self._sampling(p)
+        self.engine.validate_prompt(prompt, sampling)
+        with self._wake:
+            req = self.engine.add_request(prompt, sampling)
+            self._wake.notify_all()
+        return self._stream_events(req, text_out)
+
+    def _stream_events(self, req, text_out: bool):
+        import codecs
+
+        # incremental utf-8: token->bytes is context-free, and the decoder
+        # buffers split multi-byte characters across chunks — prefix-stable
+        # deltas in O(n) total, unlike re-decoding the whole prefix
+        utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        sent = 0
+        deadline = time.time() + self.request_timeout
+        try:
+            while True:
+                with self._wake:
+                    self._wake.wait_for(
+                        lambda: len(req.generated) > sent or req.done
+                        or self._shutdown,
+                        timeout=max(0.0, deadline - time.time()))
+                if self._shutdown or (
+                        time.time() >= deadline and not req.done):
+                    self.engine.abort([req])
+                    raise TimeoutError("generation did not finish")
+                if len(req.generated) > sent:
+                    new = list(req.generated[sent:])
+                    sent = len(req.generated)
+                    chunk = {"tokens": new}
+                    if text_out:
+                        chunk["text_delta"] = utf8.decode(
+                            self.tokenizer.decode_bytes(new),
+                            final=req.done)
+                    yield chunk
+                if req.done:
+                    if text_out:
+                        # a race between the last token chunk and the done
+                        # flag can leave buffered partial-character bytes
+                        tail = utf8.decode(b"", final=True)
+                        if tail:
+                            yield {"tokens": [], "text_delta": tail}
+                    yield {"done": True, "finish_reason": req.finish_reason,
+                           "length": len(req.generated)}
+                    return
+        finally:
+            if not req.done:
+                # client went away mid-stream: free the decode slot
+                self.engine.abort([req])
+                with self._wake:
+                    self._wake.notify_all()

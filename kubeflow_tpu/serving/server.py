@@ -22,6 +22,7 @@ from kubeflow_tpu.serving.model import (
 from kubeflow_tpu.serving.protocol import InferRequest, InferResponse
 
 _V1_PREDICT = re.compile(r"^/v1/models/([^/:]+):predict$")
+_V1_STREAM = re.compile(r"^/v1/models/([^/:]+):generate_stream$")
 _V1_EXPLAIN = re.compile(r"^/v1/models/([^/:]+):explain$")
 _V1_MODEL = re.compile(r"^/v1/models/([^/:]+)$")
 _V2_INFER = re.compile(r"^/v2/models/([^/:]+)/infer$")
@@ -139,6 +140,9 @@ class ModelServer:
                 m = _V1_PREDICT.match(path)
                 if m:
                     return self._infer(m.group(1), v1=True)
+                m = _V1_STREAM.match(path)
+                if m:
+                    return self._stream(m.group(1))
                 m = _V2_INFER.match(path)
                 if m:
                     return self._infer(m.group(1), v1=False)
@@ -191,6 +195,52 @@ class ModelServer:
                     outer.error_count += 1
                     return self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
+            def _stream(self, name: str):
+                """SSE token streaming (every LLM server's generate path):
+                `data: {json}` events per decode chunk, `data: [DONE]` at
+                the end. Body: {"inputs": <str | [token ids]>,
+                "parameters": {...}}."""
+                try:
+                    model = outer.repository.get(name)
+                    if not hasattr(model, "generate_stream"):
+                        return self._json(
+                            400, {"error": f"{name!r} is not a generative "
+                                           "model"})
+                    body = self._read_body()
+                    gen = model.generate_stream(
+                        body.get("inputs", ""), body.get("parameters"))
+                except ModelMissing as e:
+                    outer.error_count += 1
+                    return self._json(404, {"error": str(e)})
+                except Exception as e:
+                    outer.error_count += 1
+                    return self._json(
+                        400, {"error": f"{type(e).__name__}: {e}"})
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    for event in gen:
+                        self.wfile.write(
+                            b"data: " + json.dumps(event).encode() + b"\n\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    gen.close()        # aborts the request, frees the slot
+                except Exception as e:
+                    # headers are gone: surface mid-stream failures
+                    # (timeouts etc.) as an SSE error event, never a
+                    # silently truncated stream
+                    outer.error_count += 1
+                    try:
+                        self.wfile.write(
+                            b"data: " + json.dumps(
+                                {"error": f"{type(e).__name__}: {e}"}
+                            ).encode() + b"\n\ndata: [DONE]\n\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
             def _explain(self, name: str):
                 try:
                     model = outer.repository.get(name)
@@ -239,6 +289,23 @@ class InferenceClient:
     def _get(self, path: str) -> dict:
         with urlrequest.urlopen(self.url + path, timeout=self.timeout) as r:
             return json.loads(r.read())
+
+    def generate_stream(self, model: str, inputs, **params):
+        """Iterate SSE events from :generate_stream (dicts; ends on [DONE])."""
+        req = urlrequest.Request(
+            f"{self.url}/v1/models/{model}:generate_stream",
+            data=json.dumps({"inputs": inputs,
+                             "parameters": params}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urlrequest.urlopen(req, timeout=self.timeout) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    return
+                yield json.loads(payload)
 
     def predict_v1(self, model: str, instances: list, **params) -> dict:
         body = {"instances": instances}

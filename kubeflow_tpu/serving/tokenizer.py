@@ -156,6 +156,21 @@ class ByteBPETokenizer:
         text_parts.append(buf.decode("utf-8", errors="replace"))
         return "".join(text_parts)
 
+    def decode_bytes(self, ids: Iterable[int]) -> bytes:
+        """Raw UTF-8 bytes for a token-id sequence (specials skipped).
+        Token -> bytes is context-free, so callers can decode incrementally
+        (feed chunks into codecs' incremental utf-8 decoder) without the
+        split-multibyte-character instability of re-decoding prefixes."""
+        special_ids = set(self.special_tokens.values())
+        buf = bytearray()
+        for i in ids:
+            tok = self.id_to_token.get(int(i))
+            if tok is None or int(i) in special_ids:
+                continue
+            for ch in tok:
+                buf.append(self._u2b.get(ch, ord("?")))
+        return bytes(buf)
+
     # ------------------------------ io ------------------------------
 
     def save(self, path: str) -> None:

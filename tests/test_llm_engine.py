@@ -1,6 +1,7 @@
 """Continuous-batching LLM engine tests: exactness vs the full forward pass,
 request churn, sampling controls, and the HTTP generate endpoint."""
 
+import json
 import threading
 
 import jax
@@ -278,6 +279,77 @@ def test_sample_logits_controls():
     nucleus = sample_logits(logits, rng, jnp.ones(2),
                             jnp.zeros(2, jnp.int32), jnp.full((2,), 0.5))
     assert all(t == 2 for t in nucleus.tolist())
+
+
+def test_llm_streaming_generation(tiny):
+    """SSE streaming parity: chunked token events over HTTP accumulate to
+    exactly the non-streaming greedy output, then a done record."""
+    cfg, params = tiny
+    model = LLMModel("stream", params, cfg, max_batch=2, max_seq=64,
+                     prefill_buckets=(8,))
+    repo = ModelRepository()
+    repo.register(model)
+    srv = ModelServer(repo).start()
+    try:
+        cli = InferenceClient(srv.url)
+        prompt = [5, 6, 7]
+        events = list(cli.generate_stream("stream", prompt, max_tokens=20))
+        assert events[-1]["done"] and events[-1]["length"] == 20
+        token_events = [e for e in events if "tokens" in e]
+        assert len(token_events) >= 2          # chunked, not one blob
+        streamed = [t for e in token_events for t in e["tokens"]]
+        assert streamed == ref_greedy(params, cfg, prompt, 20)
+
+        # non-generative models reject the route cleanly
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            srv.url + "/v1/models/nope:generate_stream", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("expected 404")
+
+        # invalid request (prompt beyond the largest bucket) must be a
+        # REAL 400 — generate_stream validates eagerly, before the
+        # transport commits to 200 + a broken stream
+        req = urllib.request.Request(
+            srv.url + "/v1/models/stream:generate_stream",
+            data=json.dumps({"inputs": list(range(500))}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5)
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        else:
+            raise AssertionError("expected 400")
+    finally:
+        srv.stop()
+
+
+def test_stream_abort_frees_slot(tiny):
+    """Closing the stream mid-generation aborts the request: the engine
+    drains instead of decoding to max_tokens with no consumer."""
+    cfg, params = tiny
+    model = LLMModel("s2", params, cfg, max_batch=1, max_seq=64,
+                     prefill_buckets=(8,))
+    model.load()
+    try:
+        gen = model.generate_stream([5, 6, 7], {"max_tokens": 1000000000})
+        first = next(gen)
+        assert first["tokens"]
+        gen.close()                        # client disconnect
+        deadline = __import__("time").time() + 20
+        while model.engine.has_work() and __import__("time").time() < deadline:
+            __import__("time").sleep(0.05)
+        assert not model.engine.has_work()
+        assert model.engine._free == [0]   # slot back in the pool
+    finally:
+        model.unload()
 
 
 def test_llm_http_generate(tiny):

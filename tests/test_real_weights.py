@@ -130,6 +130,24 @@ class TestTokenizer:
         assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
         assert tok.decode(ids) == "hello"   # specials skipped
 
+    def test_incremental_decode_bytes_prefix_stable(self):
+        """Streaming contract: feeding decode_bytes chunks through an
+        incremental utf-8 decoder reproduces decode() exactly, even when a
+        chunk boundary splits a multi-byte character — re-decoding prefixes
+        with errors='replace' would corrupt the deltas."""
+        import codecs
+
+        tok = tok_mod.train_bpe(TEXTS, vocab_size=300)
+        text = "héllo wörld 你好 🙂 end"
+        ids = tok.encode(text, bos=False)
+        full = tok.decode(ids)
+        # every possible split point, 1-token chunks included
+        for k in range(1, len(ids)):
+            dec = codecs.getincrementaldecoder("utf-8")("replace")
+            out = dec.decode(tok.decode_bytes(ids[:k]))
+            out += dec.decode(tok.decode_bytes(ids[k:]), final=True)
+            assert out == full, (k, out, full)
+
     def test_merges_actually_merge(self):
         tok = tok_mod.train_bpe(TEXTS, vocab_size=400)
         per_byte = len("the quick brown fox".encode())
