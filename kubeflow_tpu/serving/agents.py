@@ -278,7 +278,14 @@ class ModelPuller:
 
         def loop():
             while not stop.wait(period):
-                self.sync()
+                # one bad descriptor (unreachable uri, malformed
+                # checkpoint) must not kill the watcher for the rest of
+                # the server's life
+                try:
+                    self.sync()
+                except Exception as e:
+                    print(f"model-puller sync failed: "
+                          f"{type(e).__name__}: {e}", flush=True)
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()

@@ -216,10 +216,12 @@ class LLMModel(Model):
                 while prompt and prompt[-1] == self.pad_id:
                     prompt.pop()
                 prompts.append(prompt)
-        # validate EVERY row before enqueuing ANY: a mid-batch rejection must
-        # not leave earlier rows generating with no caller to collect them
+        # validate EVERY row (including its KV-block reservation, which
+        # needs the sampling params) before enqueuing ANY: a mid-batch
+        # rejection must not leave earlier rows generating with no caller
+        # to collect them
         for prompt in prompts:
-            self.engine.validate_prompt(prompt)
+            self.engine.validate_prompt(prompt, sampling)
         reqs = []
         with self._wake:
             for prompt in prompts:
@@ -271,8 +273,9 @@ class LLMModel(Model):
             prompt = [int(t) for t in inputs]
             text_out = self.tokenizer is not None
         sampling = self._sampling(p)
-        self.engine.validate_prompt(prompt, sampling)
         with self._wake:
+            # add_request validates eagerly (prompt + KV reservation) in
+            # THIS thread — a bad request raises before any 200 commits
             req = self.engine.add_request(prompt, sampling)
             self._wake.notify_all()
         return self._stream_events(req, text_out)

@@ -80,14 +80,34 @@ def main(argv=None) -> int:
         path = init_storage(env)
         print(f"storage-initializer: materialized {path}", flush=True)
         return 0
-    model = build_model_from_env(env)
     repo = ModelRepository()
-    repo.register(model)               # load()s eagerly: warm before ready
+    if env.get("KFT_STORAGE_URI") or not env.get("KFT_MODELS_CONFIG_DIR"):
+        model = build_model_from_env(env)
+        repo.register(model)           # load()s eagerly: warm before ready
+    # multi-model mode (the kserve agent/TrainedModel role): watch a config
+    # directory of {"name","storage_uri",...} descriptors and hot load /
+    # unload models into the same server
+    watch_dir = env.get("KFT_MODELS_CONFIG_DIR")
+    if watch_dir:
+        from kubeflow_tpu.serving.agents import ModelPuller
+
+        def factory(desc, local):
+            sub = {**env, "KFT_MODEL_NAME": desc["name"],
+                   "KFT_MODEL_DIR": local, "KFT_STORAGE_URI": "",
+                   **{k: str(v) for k, v in desc.get("env", {}).items()}}
+            return build_model_from_env(sub)
+
+        puller = ModelPuller(
+            repo, watch_dir, factory,
+            model_dir=env.get("KFT_MODEL_DIR", "/mnt/models"))
+        puller.sync()
+        puller.watch(period=float(env.get("KFT_MODELS_SYNC_PERIOD", "2.0")))
+        print(f"model-puller watching {watch_dir}", flush=True)
     bind = env.get("KFT_BIND", "127.0.0.1:8080")
     host, _, port = bind.rpartition(":")
     server = ModelServer(repo, host=host or "127.0.0.1", port=int(port))
     server.start()
-    print(f"serving {model.name!r} at {server.url}", flush=True)
+    print(f"serving {repo.names()} at {server.url}", flush=True)
     # optional binary data plane (the gRPC-port role; see serving/v2_socket)
     v2_bind = env.get("KFT_V2_SOCKET_BIND")
     if v2_bind:

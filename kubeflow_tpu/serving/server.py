@@ -219,6 +219,11 @@ class ModelServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                # unframed body (no length, no chunking): the connection
+                # must close after [DONE] or keep-alive clients reading to
+                # EOF hang and pipelined requests misread the stream
+                self.send_header("Connection", "close")
+                self.close_connection = True
                 self.end_headers()
                 try:
                     for event in gen:

@@ -294,3 +294,76 @@ def test_isvc_real_weights_text_e2e(tmp_path):
         assert len(preds) == 1 and isinstance(preds[0], str)
     finally:
         cluster.shutdown()
+
+
+def test_multi_model_runtime_hot_loads(tmp_path):
+    """Multi-model serving (the kserve agent/TrainedModel role): the
+    runtime watches a config dir, hot-loads descriptors into one server,
+    and unloads on removal — driven as a real subprocess."""
+    import subprocess
+
+    m1, _, _, _ = _fixture_checkpoint(tmp_path / "a")
+    m2, _, _, _ = _fixture_checkpoint(tmp_path / "b")
+    cfg_dir = tmp_path / "models-config"
+    cfg_dir.mkdir()
+    for name, path in (("alpha", m1), ("beta", m2)):
+        (cfg_dir / f"{name}.json").write_text(json.dumps(
+            {"name": name, "storage_uri": f"file://{path}"}))
+
+    env = {**os.environ,
+           "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", ""),
+           "JAX_PLATFORMS": "cpu",
+           "KFT_MODELS_CONFIG_DIR": str(cfg_dir),
+           "KFT_MODEL_DIR": str(tmp_path / "mnt"),
+           "KFT_DTYPE": "float32",
+           "KFT_MAX_BATCH": "2", "KFT_MAX_SEQ": "128",
+           "KFT_MODELS_SYNC_PERIOD": "0.5",
+           "KFT_BIND": "127.0.0.1:0"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.serving.runtime"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    try:
+        url = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "] at http" in line:
+                url = line.rsplit(" at ", 1)[1].strip()
+                break
+        assert url, "runtime did not start"
+
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                idx = {m["name"] for m in get("/v2/repository/index")}
+                if {"alpha", "beta"} <= idx:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert {"alpha", "beta"} <= idx
+
+        body = json.dumps({"instances": ["hi"],
+                           "parameters": {"max_tokens": 3}}).encode()
+        for name in ("alpha", "beta"):
+            req = urllib.request.Request(
+                url + f"/v1/models/{name}:predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert json.loads(r.read())["predictions"]
+
+        (cfg_dir / "beta.json").unlink()          # hot unload
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            idx = {m["name"] for m in get("/v2/repository/index")}
+            if "beta" not in idx:
+                break
+            time.sleep(0.5)
+        assert "beta" not in idx and "alpha" in idx
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
