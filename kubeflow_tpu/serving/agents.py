@@ -250,15 +250,24 @@ class ModelPuller:
                     current[desc["name"]] = desc
                 except (OSError, ValueError, KeyError):
                     continue
-        loaded, unloaded = [], []
+        loaded, unloaded, errors = [], [], {}
         for name, desc in current.items():
             if self._seen.get(name) == desc:
                 continue
-            local = os.path.join(self.model_dir, name)
-            if desc.get("storage_uri"):
-                os.makedirs(local, exist_ok=True)
-                local = self.download(desc["storage_uri"], local)
-            self.repository.register(self.factory(desc, local))
+            # per-descriptor isolation: one unreachable uri or malformed
+            # checkpoint must not starve later models of this pass (or, at
+            # startup, crash the server)
+            try:
+                local = os.path.join(self.model_dir, name)
+                if desc.get("storage_uri"):
+                    os.makedirs(local, exist_ok=True)
+                    local = self.download(desc["storage_uri"], local)
+                self.repository.register(self.factory(desc, local))
+            except Exception as e:
+                errors[name] = f"{type(e).__name__}: {e}"
+                print(f"model-puller: {name} failed: {errors[name]}",
+                      flush=True)
+                continue
             self._seen[name] = desc
             loaded.append(name)
         for name in list(self._seen):
@@ -269,7 +278,7 @@ class ModelPuller:
                     pass
                 del self._seen[name]
                 unloaded.append(name)
-        return {"loaded": loaded, "unloaded": unloaded}
+        return {"loaded": loaded, "unloaded": unloaded, "errors": errors}
 
     def watch(self, period: float = 2.0,
               stop: Optional[threading.Event] = None) -> threading.Thread:

@@ -95,6 +95,61 @@ class JAXModel(Model):
                                         id=request.id)
 
 
+class _StopMatcher:
+    """Incremental text-level stop-string watcher for one request.
+
+    Feeds token ids through the tokenizer's context-free byte stream and
+    tracks, per token, the cumulative decoded length — so a match can be
+    cut EXACTLY: text truncates at the match start (stop string excluded,
+    the vLLM/HF convention) and tokens truncate to those fully before it.
+    ``safe_len`` is how much text streaming may emit while unmatched: a
+    stop string split across decode chunks must never leak its prefix.
+    """
+
+    def __init__(self, tokenizer, stops: list[str]):
+        import codecs
+
+        self._tok = tokenizer
+        self._utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        self.stops = stops
+        self.max_stop = max(len(s) for s in stops)
+        self.text = ""
+        self._cum: list[int] = []       # text length after each token
+        self.match_at: Optional[int] = None
+
+    def feed(self, new_tokens) -> bool:
+        prev_len = len(self.text)
+        for t in new_tokens:
+            self.text += self._utf8.decode(self._tok.decode_bytes([t]))
+            self._cum.append(len(self.text))
+        # scan only the window a NEW match could occupy (old text minus a
+        # possible straddle) — O(total chars), not O(chars x chunks)
+        for s in self.stops:
+            start = max(0, prev_len - len(s) + 1)
+            i = self.text.find(s, start)
+            if i >= 0 and (self.match_at is None or i < self.match_at):
+                self.match_at = i
+        return self.match_at is not None
+
+    @property
+    def final_text(self) -> str:
+        return self.text if self.match_at is None \
+            else self.text[:self.match_at]
+
+    @property
+    def token_cut(self) -> int:
+        """Tokens to keep: those decoded entirely before the match."""
+        if self.match_at is None:
+            return len(self._cum)
+        return sum(1 for n in self._cum if n <= self.match_at)
+
+    @property
+    def safe_len(self) -> int:
+        if self.match_at is not None:
+            return self.match_at
+        return max(0, len(self.text) - (self.max_stop - 1))
+
+
 class LLMModel(Model):
     """Generate endpoint over the continuous-batching engine.
 
@@ -193,7 +248,20 @@ class LLMModel(Model):
             top_k=int(p.get("top_k", 0)),
             top_p=float(p.get("top_p", 1.0)),
             eos_id=(int(p["eos_id"]) if "eos_id" in p else eos_default),
+            stop_token_ids=tuple(
+                int(t) for t in (p.get("stop_token_ids") or ())),
         )
+
+    def _stop_strings(self, p: dict) -> list[str]:
+        stop = p.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        stop = [str(s) for s in stop if s]
+        if stop and self.tokenizer is None:
+            raise ValueError(
+                f"model {self.name!r} has no tokenizer; stop strings need "
+                "one (use stop_token_ids)")
+        return stop
 
     def predict(self, request: InferRequest) -> InferResponse:
         arr = request.as_numpy()
@@ -222,15 +290,42 @@ class LLMModel(Model):
         # to collect them
         for prompt in prompts:
             self.engine.validate_prompt(prompt, sampling)
+        stop = self._stop_strings(p)
         reqs = []
         with self._wake:
             for prompt in prompts:
                 reqs.append(self.engine.add_request(prompt, sampling))
             self._wake.notify_all()
+        matchers: dict[int, _StopMatcher] = {}
+        fed: dict[int, int] = {}
+        if stop:
+            for r in reqs:
+                matchers[r.id] = _StopMatcher(self.tokenizer, stop)
+                fed[r.id] = 0
+
+        def _ready() -> bool:
+            if self._shutdown:
+                return True
+            # stop-string watch runs on the waiter's wakeups (chunk
+            # granularity): on a match the request aborts as a clean
+            # "stop" and its slot frees immediately
+            for r in reqs:
+                m = matchers.get(r.id)
+                if m is None or m.match_at is not None:
+                    continue
+                n = len(r.generated)
+                if n > fed[r.id]:
+                    if m.feed(r.generated[fed[r.id]:n]):
+                        # even when the request already ended by length,
+                        # output IS stop-truncated: report "stop"
+                        r.stop_matched = True
+                        if not r.done:
+                            self.engine.abort([r])
+                    fed[r.id] = n
+            return all(r.done for r in reqs)
+
         with self._wake:
-            self._wake.wait_for(lambda: all(r.done for r in reqs)
-                                or self._shutdown,
-                                timeout=self.request_timeout)
+            self._wake.wait_for(_ready, timeout=self.request_timeout)
         if not all(r.done for r in reqs):
             # free the decode slots before surfacing the failure — otherwise
             # the timed-out requests occupy slots until max_tokens
@@ -238,16 +333,27 @@ class LLMModel(Model):
             with self._wake:
                 self._wake.notify_all()
             raise TimeoutError("generation did not finish")
-        lengths = np.asarray([len(r.generated) for r in reqs], np.int32)
+        def _final(r):
+            """(tokens, text) with stop-string truncation applied: text
+            cuts at the match start (stop excluded), tokens to those fully
+            before it."""
+            m = matchers.get(r.id)
+            if m is not None and m.match_at is not None:
+                return r.generated[:m.token_cut], m.final_text
+            toks = list(r.generated)
+            return toks, (self.tokenizer.decode(toks)
+                          if self.tokenizer is not None else None)
+
+        finals = [_final(r) for r in reqs]
+        lengths = np.asarray([len(t) for t, _ in finals], np.int32)
         outputs: dict[str, np.ndarray] = {}
         if text_in:
             outputs["text"] = np.asarray(
-                [self.tokenizer.decode(r.generated) for r in reqs],
-                dtype=object)
-        max_new = max(len(r.generated) for r in reqs)
+                [txt for _, txt in finals], dtype=object)
+        max_new = max(1, max(len(t) for t, _ in finals))
         tokens = np.full((len(reqs), max_new), self.pad_id, np.int32)
-        for i, r in enumerate(reqs):
-            tokens[i, :len(r.generated)] = r.generated
+        for i, (toks, _) in enumerate(finals):
+            tokens[i, :len(toks)] = toks
         outputs["tokens"] = tokens
         outputs["lengths"] = lengths
         return InferResponse.from_numpy(self.name, outputs, id=request.id)
@@ -273,21 +379,34 @@ class LLMModel(Model):
             prompt = [int(t) for t in inputs]
             text_out = self.tokenizer is not None
         sampling = self._sampling(p)
+        stop = self._stop_strings(p)
         with self._wake:
             # add_request validates eagerly (prompt + KV reservation) in
             # THIS thread — a bad request raises before any 200 commits
             req = self.engine.add_request(prompt, sampling)
             self._wake.notify_all()
-        return self._stream_events(req, text_out)
+        return self._stream_events(req, text_out, stop)
 
-    def _stream_events(self, req, text_out: bool):
+    def _stream_events(self, req, text_out: bool, stop: list[str]):
+        """With stop strings, text deltas are exact (held back behind any
+        possible partial match) and the final ``length`` is the authoritative
+        truncated token count — a stop straddling a chunk boundary may have
+        already streamed a few of its leading tokens in the prior chunk, so
+        token reassembly should cut to ``length``."""
         import codecs
 
         # incremental utf-8: token->bytes is context-free, and the decoder
         # buffers split multi-byte characters across chunks — prefix-stable
         # deltas in O(n) total, unlike re-decoding the whole prefix
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        # with stop strings, the matcher owns the text and deltas hold back
+        # the last len(stop)-1 chars so a stop split across chunks can
+        # never leak its prefix to the client
+        matcher = (_StopMatcher(self.tokenizer, stop)
+                   if stop and text_out else None)
         sent = 0
+        emitted = 0
+        tokens_emitted = 0
         deadline = time.time() + self.request_timeout
         try:
             while True:
@@ -304,20 +423,43 @@ class LLMModel(Model):
                     new = list(req.generated[sent:])
                     sent = len(req.generated)
                     chunk = {"tokens": new}
-                    if text_out:
+                    if matcher is not None:
+                        if matcher.feed(new):
+                            req.stop_matched = True
+                            if not req.done:
+                                self.engine.abort([req])
+                                with self._wake:
+                                    self._wake.notify_all()
+                        # token stream truncates like predict(): never emit
+                        # tokens at/after the match
+                        keep = matcher.token_cut - tokens_emitted
+                        chunk["tokens"] = new[:max(0, keep)]
+                        tokens_emitted += len(chunk["tokens"])
+                        safe = matcher.safe_len
+                        chunk["text_delta"] = matcher.text[emitted:safe]
+                        emitted = safe
+                    elif text_out:
                         chunk["text_delta"] = utf8.decode(
                             self.tokenizer.decode_bytes(new),
                             final=req.done)
-                    yield chunk
+                    if chunk["tokens"] or chunk.get("text_delta"):
+                        yield chunk
                 if req.done:
-                    if text_out:
-                        # a race between the last token chunk and the done
-                        # flag can leave buffered partial-character bytes
-                        tail = utf8.decode(b"", final=True)
+                    if matcher is not None:
+                        tail = matcher.final_text[emitted:]
                         if tail:
                             yield {"tokens": [], "text_delta": tail}
+                        length = matcher.token_cut
+                    else:
+                        if text_out:
+                            # a race between the last token chunk and the
+                            # done flag can leave buffered partial bytes
+                            tail = utf8.decode(b"", final=True)
+                            if tail:
+                                yield {"tokens": [], "text_delta": tail}
+                        length = len(req.generated)
                     yield {"done": True, "finish_reason": req.finish_reason,
-                           "length": len(req.generated)}
+                           "length": length}
                     return
         finally:
             if not req.done:

