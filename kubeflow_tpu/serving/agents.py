@@ -236,6 +236,7 @@ class ModelPuller:
             download = dl
         self.download = download
         self._seen: dict[str, dict] = {}
+        self._failed: dict[str, dict] = {}   # descriptor content at failure
 
     def sync(self) -> dict:
         """One reconcile pass. Returns {"loaded": [...], "unloaded": [...]}"""
@@ -254,6 +255,11 @@ class ModelPuller:
         for name, desc in current.items():
             if self._seen.get(name) == desc:
                 continue
+            if self._failed.get(name) == desc:
+                # an UNCHANGED bad descriptor is not retried every pass —
+                # re-downloading a broken multi-GB checkpoint on a 2s
+                # period is pure churn; edit the file to retry
+                continue
             # per-descriptor isolation: one unreachable uri or malformed
             # checkpoint must not starve later models of this pass (or, at
             # startup, crash the server)
@@ -265,9 +271,11 @@ class ModelPuller:
                 self.repository.register(self.factory(desc, local))
             except Exception as e:
                 errors[name] = f"{type(e).__name__}: {e}"
+                self._failed[name] = desc
                 print(f"model-puller: {name} failed: {errors[name]}",
                       flush=True)
                 continue
+            self._failed.pop(name, None)
             self._seen[name] = desc
             loaded.append(name)
         for name in list(self._seen):
@@ -278,6 +286,9 @@ class ModelPuller:
                     pass
                 del self._seen[name]
                 unloaded.append(name)
+        # removed descriptors also clear their failure memory
+        self._failed = {k: v for k, v in self._failed.items()
+                        if k in current}
         return {"loaded": loaded, "unloaded": unloaded, "errors": errors}
 
     def watch(self, period: float = 2.0,

@@ -149,6 +149,12 @@ class _StopMatcher:
             return self.match_at
         return max(0, len(self.text) - (self.max_stop - 1))
 
+    def finish(self) -> None:
+        """Flush bytes buffered mid-multibyte-character (a generation can
+        end on a split character; predict's full decode renders the
+        replacement char, so the stream must too)."""
+        self.text += self._utf8.decode(b"", final=True)
+
 
 class LLMModel(Model):
     """Generate endpoint over the continuous-batching engine.
@@ -263,6 +269,22 @@ class LLMModel(Model):
                 "one (use stop_token_ids)")
         return stop
 
+    def stats(self) -> dict:
+        """Engine gauges for the /metrics scrape (KPA + capacity planning):
+        generated token count, decode steps, KV pool occupancy, prefix hits."""
+        eng = self.engine
+        if eng is None:
+            return {}
+        return {
+            "generated_tokens_total": eng.generated_tokens,
+            "decode_steps_total": eng.steps,
+            "active_requests": len(eng._active),
+            "waiting_requests": len(eng._waiting),
+            "kv_free_blocks": eng.paged.allocator.free_blocks,
+            "kv_reclaimable_blocks": eng.paged.reclaimable_blocks,
+            "prefix_cache_hits_total": eng.paged.prefix_hits,
+        }
+
     def predict(self, request: InferRequest) -> InferResponse:
         arr = request.as_numpy()
         p = request.parameters
@@ -334,28 +356,35 @@ class LLMModel(Model):
                 self._wake.notify_all()
             raise TimeoutError("generation did not finish")
         def _final(r):
-            """(tokens, text) with stop-string truncation applied: text
-            cuts at the match start (stop excluded), tokens to those fully
-            before it."""
+            """(tokens, logprobs, text) with stop-string truncation applied:
+            text cuts at the match start (stop excluded), tokens/logprobs to
+            those fully before it."""
             m = matchers.get(r.id)
             if m is not None and m.match_at is not None:
-                return r.generated[:m.token_cut], m.final_text
+                cut = m.token_cut
+                return r.generated[:cut], r.logprobs[:cut], m.final_text
             toks = list(r.generated)
-            return toks, (self.tokenizer.decode(toks)
-                          if self.tokenizer is not None else None)
+            return toks, list(r.logprobs), (
+                self.tokenizer.decode(toks)
+                if text_in and self.tokenizer is not None else None)
 
         finals = [_final(r) for r in reqs]
-        lengths = np.asarray([len(t) for t, _ in finals], np.int32)
+        lengths = np.asarray([len(t) for t, _, _ in finals], np.int32)
         outputs: dict[str, np.ndarray] = {}
         if text_in:
             outputs["text"] = np.asarray(
-                [txt for _, txt in finals], dtype=object)
-        max_new = max(1, max(len(t) for t, _ in finals))
+                [txt for _, _, txt in finals], dtype=object)
+        max_new = max(1, max(len(t) for t, _, _ in finals))
         tokens = np.full((len(reqs), max_new), self.pad_id, np.int32)
-        for i, (toks, _) in enumerate(finals):
+        for i, (toks, _, _) in enumerate(finals):
             tokens[i, :len(toks)] = toks
         outputs["tokens"] = tokens
         outputs["lengths"] = lengths
+        if p.get("logprobs"):
+            lp = np.zeros((len(reqs), max_new), np.float32)
+            for i, (_, lps, _) in enumerate(finals):
+                lp[i, :len(lps)] = lps
+            outputs["logprobs"] = lp
         return InferResponse.from_numpy(self.name, outputs, id=request.id)
 
     def generate_stream(self, inputs, parameters: Optional[dict] = None):
@@ -385,9 +414,12 @@ class LLMModel(Model):
             # THIS thread — a bad request raises before any 200 commits
             req = self.engine.add_request(prompt, sampling)
             self._wake.notify_all()
-        return self._stream_events(req, text_out, stop)
+        return self._stream_events(req, text_out, stop,
+                                   want_logprobs=bool(
+                                       p.get("logprobs")))
 
-    def _stream_events(self, req, text_out: bool, stop: list[str]):
+    def _stream_events(self, req, text_out: bool, stop: list[str],
+                       want_logprobs: bool = False):
         """With stop strings, text deltas are exact (held back behind any
         possible partial match) and the final ``length`` is the authoritative
         truncated token count — a stop straddling a chunk boundary may have
@@ -420,9 +452,21 @@ class LLMModel(Model):
                     self.engine.abort([req])
                     raise TimeoutError("generation did not finish")
                 if len(req.generated) > sent:
-                    new = list(req.generated[sent:])
-                    sent = len(req.generated)
+                    # the engine appends generated then logprobs; cap the
+                    # read at what BOTH lists cover so a mid-append wakeup
+                    # can never mis-pair the stream (the straggler token
+                    # flushes on the next wake)
+                    n_avail = len(req.generated)
+                    if want_logprobs:
+                        n_avail = min(n_avail, len(req.logprobs))
+                        if n_avail <= sent and not req.done:
+                            continue
+                    new = list(req.generated[sent:n_avail])
+                    new_lps = list(req.logprobs[sent:n_avail])
+                    sent = n_avail
                     chunk = {"tokens": new}
+                    if want_logprobs:
+                        chunk["logprobs"] = new_lps
                     if matcher is not None:
                         if matcher.feed(new):
                             req.stop_matched = True
@@ -434,6 +478,8 @@ class LLMModel(Model):
                         # tokens at/after the match
                         keep = matcher.token_cut - tokens_emitted
                         chunk["tokens"] = new[:max(0, keep)]
+                        if want_logprobs:
+                            chunk["logprobs"] = new_lps[:len(chunk["tokens"])]
                         tokens_emitted += len(chunk["tokens"])
                         safe = matcher.safe_len
                         chunk["text_delta"] = matcher.text[emitted:safe]
@@ -446,6 +492,7 @@ class LLMModel(Model):
                         yield chunk
                 if req.done:
                     if matcher is not None:
+                        matcher.finish()
                         tail = matcher.final_text[emitted:]
                         if tail:
                             yield {"tokens": [], "text_delta": tail}

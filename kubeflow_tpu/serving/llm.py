@@ -47,6 +47,8 @@ class GenRequest:
     prompt: list[int]
     sampling: SamplingParams
     generated: list[int] = dataclasses.field(default_factory=list)
+    # per-generated-token logprob under the model distribution
+    logprobs: list[float] = dataclasses.field(default_factory=list)
     done: bool = False
     aborted: bool = False
     # set by a text-level stop-string watcher before aborting: the abort
@@ -56,8 +58,12 @@ class GenRequest:
 
     @property
     def finish_reason(self) -> str:
+        if self.stop_matched:
+            # a stop-string match is a clean stop even when the request
+            # also hit its length cap before the watcher saw the match
+            return "stop"
         if self.aborted:
-            return "stop" if self.stop_matched else "abort"
+            return "abort"
         if self.generated and (
                 (self.sampling.eos_id is not None
                  and self.generated[-1] == self.sampling.eos_id)
@@ -184,14 +190,20 @@ class LLMEngine:
             logits, cache = paged_decode_step(
                 params, token, self.cfg, cache, tables)
             nxt = sample_logits(logits, rng_step, temperature, top_k, top_p)
+            # chosen-token logprob under the MODEL distribution (OpenAI
+            # convention: pre-temperature/filtering)
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1),
+                nxt[:, None], axis=-1)[:, 0]
             # idle slots: pin len to 0 so the cursor can't creep toward
             # max_seq (their scatter lands in the scratch block 0)
             cache["len"] = jnp.where(active, cache["len"], 0)
-            return (nxt, cache), nxt
+            return (nxt, cache), (nxt, lp)
 
         rngs = jax.random.split(rng, self.decode_chunk)
-        (_, cache), toks = jax.lax.scan(one_step, (token, cache), rngs)
-        return toks, cache                       # toks: [chunk, B]
+        (_, cache), (toks, lps) = jax.lax.scan(
+            one_step, (token, cache), rngs)
+        return toks, lps, cache                  # toks/lps: [chunk, B]
 
     def _insert_impl(self, cache, k_new, v_new, blk_ids, length, slot):
         from kubeflow_tpu.serving.paged_kv import paged_insert
@@ -281,12 +293,13 @@ class LLMEngine:
             top_k[slot] = req.sampling.top_k
             top_p[slot] = req.sampling.top_p
         self._rng, step_rng = jax.random.split(self._rng)
-        toks, self.cache = self._decode(
+        toks, lps, self.cache = self._decode(
             self.params, jnp.asarray(self._tokens), self.cache,
             jnp.asarray(self.paged.tables),
             jnp.asarray(active_mask), jnp.asarray(temp),
             jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
         toks = np.asarray(toks)                 # [chunk, B]
+        lps = np.asarray(lps)
         self.steps += toks.shape[0]
 
         finished = []
@@ -296,6 +309,7 @@ class LLMEngine:
             for t in range(toks.shape[0]):
                 tok = int(toks[t, slot])
                 req.generated.append(tok)
+                req.logprobs.append(float(lps[t, slot]))
                 self.generated_tokens += 1
                 self._tokens[slot] = tok
                 if (eos is not None and tok == eos) or tok in stop_ids or \
@@ -362,6 +376,8 @@ class LLMEngine:
                 jnp.asarray([req.sampling.top_k], jnp.int32),
                 jnp.asarray([req.sampling.top_p], jnp.float32))
             first_tok = int(np.asarray(first)[0])
+            first_lp = float(np.asarray(jax.nn.log_softmax(
+                logits[0]))[first_tok])
             # write only the blocks covering the true prompt length (pad
             # rows past them are never attended), and within those skip the
             # shared prefix blocks — their identical KV is already resident
@@ -379,6 +395,7 @@ class LLMEngine:
             # the prefill-sampled token is generation token #1; decode
             # continues from it
             req.generated.append(first_tok)
+            req.logprobs.append(first_lp)
             self.generated_tokens += 1
             req.slot = slot
             self._tokens[slot] = first_tok

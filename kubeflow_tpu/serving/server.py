@@ -102,6 +102,17 @@ class ModelServer:
                         # minus this scrape itself
                         f"kft_requests_in_flight {max(0, outer.in_flight - 1)}\n"
                     )
+                    # per-model engine gauges (models exposing stats());
+                    # tolerate hot unload racing the scrape
+                    for mname in outer.repository.names():
+                        try:
+                            mdl = outer.repository.get(mname)
+                            stats = getattr(mdl, "stats", dict)() or {}
+                        except ModelMissing:
+                            continue
+                        for k, v in stats.items():
+                            text += (f'kft_model_{k}'
+                                     f'{{model="{mname}"}} {v}\n')
                     body = text.encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")

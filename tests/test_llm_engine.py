@@ -352,6 +352,51 @@ def test_stream_abort_frees_slot(tiny):
         model.unload()
 
 
+def test_logprobs_match_teacher_forced_reference(tiny):
+    """Every generated token carries its logprob under the MODEL
+    distribution (OpenAI convention) — consistent with a teacher-forced
+    full-forward log_softmax, across the prefill-sampled first token and
+    chunked decode."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(8,))
+    prompt = [5, 6, 7]
+    r = eng.generate([prompt], SamplingParams(max_tokens=6))[0]
+    assert len(r.logprobs) == len(r.generated) == 6
+    toks = list(prompt)
+    for g, lp in zip(r.generated, r.logprobs):
+        logits = llama.forward(params, jnp.asarray([toks]), cfg)[0, -1]
+        assert abs(float(jax.nn.log_softmax(logits)[g]) - lp) < 2e-2
+        assert lp <= 0.0
+        toks.append(g)
+
+
+def test_logprobs_surface_in_predict_and_stream(tiny):
+    cfg, params = tiny
+    model = LLMModel("lp", params, cfg, max_batch=2, max_seq=64,
+                     prefill_buckets=(8,))
+    model.load()
+    try:
+        from kubeflow_tpu.serving.protocol import InferRequest
+
+        req = InferRequest.from_v1("lp", {
+            "instances": [[5, 6, 7]],
+            "parameters": {"max_tokens": 5, "logprobs": True}})
+        out = model(req)
+        lp = out.as_numpy("logprobs")
+        toks = out.as_numpy("tokens")
+        assert lp.shape == toks.shape and (lp <= 0.0).all()
+
+        events = list(model.generate_stream(
+            [5, 6, 7], {"max_tokens": 5, "logprobs": True}))
+        streamed = [x for e in events if "tokens" in e
+                    for x in e.get("logprobs", [])]
+        assert len(streamed) == 5
+        np.testing.assert_allclose(streamed, lp[0, :5], rtol=1e-5)
+    finally:
+        model.unload()
+
+
 def test_stop_token_ids_end_generation(tiny):
     cfg, params = tiny
     eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
