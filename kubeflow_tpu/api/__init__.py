@@ -1,5 +1,6 @@
 from kubeflow_tpu.api.types import (
-    CleanPodPolicy, Condition, ConditionType, JobSpec, JobStatus, PodTemplate,
-    ReplicaSpec, ReplicaType, RestartPolicy, RunPolicy, SchedulingPolicy,
-    TPUSpec, ValidationError, from_yaml, jax_job, tf_job, to_yaml, validate,
+    CleanPodPolicy, Condition, ConditionType, ElasticPolicy, JobSpec,
+    JobStatus, PodTemplate, ReplicaSpec, ReplicaType, RestartPolicy,
+    RunPolicy, SchedulingPolicy, TPUSpec, ValidationError, from_yaml,
+    jax_job, pytorch_job, tf_job, to_yaml, validate, xgboost_job,
 )

@@ -52,6 +52,8 @@ class ReplicaType(str, enum.Enum):
     CHIEF = "Chief"
     PS = "PS"
     EVALUATOR = "Evaluator"
+    # PyTorchJob/XGBoostJob-compat role (rank-0 / tracker anchor)
+    MASTER = "Master"
 
 
 @dataclasses.dataclass
@@ -145,6 +147,19 @@ class JobStatus:
 
 
 @dataclasses.dataclass
+class ElasticPolicy:
+    """PyTorchJob-compat elastic policy (reference: ElasticPolicy on
+    PyTorchJob — torchrun c10d rendezvous with a min/max world size).
+    The controller exports it as the PET_* env contract torchrun reads."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    nproc_per_node: int = 1
+    rdzv_backend: str = "c10d"
+    max_restarts: int = 3
+
+
+@dataclasses.dataclass
 class JobSpec:
     """Base job: named replica groups + run policy. Kind-specific rendezvous
     env is produced by the controller's `cluster_env()` per kind."""
@@ -157,6 +172,7 @@ class JobSpec:
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     status: JobStatus = dataclasses.field(default_factory=JobStatus)
     uid: str = ""
+    elastic: Optional[ElasticPolicy] = None   # PyTorchJob kinds only
 
     @property
     def total_replicas(self) -> int:
@@ -219,6 +235,59 @@ def tf_job(
     return JobSpec(name=name, namespace=namespace, kind="TFJob", replica_specs=specs)
 
 
+def pytorch_job(
+    name: str,
+    *,
+    workers: int = 1,
+    master: bool = True,
+    image: str = "kubeflow-tpu/runtime:latest",
+    command: list[str] | None = None,
+    env: dict[str, str] | None = None,
+    elastic: ElasticPolicy | None = None,
+    namespace: str = "default",
+) -> JobSpec:
+    """PyTorchJob-compatible kind (reference: pkg/controller.v1/pytorch).
+
+    The controller exports the torch.distributed rendezvous contract
+    (MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK; PET_* when elastic). On TPU
+    the same env feeds PyTorch/XLA, whose xla:// init reads it unchanged —
+    so one kind serves both CPU-gloo tests and torch-on-TPU."""
+    tmpl = lambda: PodTemplate(
+        image=image, command=list(command or []), env=dict(env or {}))
+    specs: dict[str, ReplicaSpec] = {}
+    if master:
+        specs[ReplicaType.MASTER.value] = ReplicaSpec(replicas=1, template=tmpl())
+    if workers:
+        specs[ReplicaType.WORKER.value] = ReplicaSpec(
+            replicas=workers, template=tmpl())
+    return JobSpec(name=name, namespace=namespace, kind="PyTorchJob",
+                   replica_specs=specs, elastic=elastic)
+
+
+def xgboost_job(
+    name: str,
+    *,
+    workers: int = 1,
+    image: str = "kubeflow-tpu/runtime:latest",
+    command: list[str] | None = None,
+    env: dict[str, str] | None = None,
+    namespace: str = "default",
+) -> JobSpec:
+    """XGBoostJob-compatible kind (reference: pkg/controller.v1/xgboost —
+    Rabit tracker rendezvous: MASTER_ADDR/MASTER_PORT + WORLD_SIZE/RANK,
+    with the Master replica hosting the tracker)."""
+    tmpl = lambda: PodTemplate(
+        image=image, command=list(command or []), env=dict(env or {}))
+    specs = {
+        ReplicaType.MASTER.value: ReplicaSpec(replicas=1, template=tmpl()),
+    }
+    if workers:
+        specs[ReplicaType.WORKER.value] = ReplicaSpec(
+            replicas=workers, template=tmpl())
+    return JobSpec(name=name, namespace=namespace, kind="XGBoostJob",
+                   replica_specs=specs)
+
+
 # ---------------------------------------------------------------------------
 # Validation (the reference's validating-admission-webhook equivalent,
 # SURVEY.md §2.1 'Webhooks')
@@ -256,6 +325,25 @@ def validate(job: JobSpec) -> None:
                 axis = part.split("=")[0]
                 if axis not in AXIS_ORDER:
                     raise ValidationError(f"unknown mesh axis {axis!r} in KFT_MESH")
+    if job.elastic is not None and job.kind != "PyTorchJob":
+        raise ValidationError(f"elastic policy is not valid for kind {job.kind}")
+    if job.kind in ("PyTorchJob", "XGBoostJob"):
+        m = job.replica_specs.get(ReplicaType.MASTER.value)
+        if m is not None and m.replicas != 1:
+            raise ValidationError(f"{job.kind}: Master must have exactly 1 replica")
+        if m is None and job.kind == "XGBoostJob":
+            raise ValidationError("XGBoostJob requires a Master replica spec")
+        if m is None and ReplicaType.WORKER.value not in job.replica_specs:
+            raise ValidationError(
+                f"{job.kind} requires a Master or Worker replica spec")
+        if job.elastic is not None:
+            e = job.elastic
+            if not (1 <= e.min_replicas <= e.max_replicas):
+                raise ValidationError(
+                    "elastic: need 1 <= min_replicas <= max_replicas")
+            if e.nproc_per_node < 1 or e.max_restarts < 0:
+                raise ValidationError(
+                    "elastic: need nproc_per_node >= 1 and max_restarts >= 0")
     sched = job.run_policy.scheduling
     if sched.min_available is not None and sched.min_available > job.total_replicas:
         raise ValidationError(
@@ -300,6 +388,8 @@ def to_yaml(job: JobSpec) -> str:
             "runPolicy": _to_plain(job.run_policy),
         },
     }
+    if job.elastic is not None:
+        doc["spec"]["elasticPolicy"] = _to_plain(job.elastic)
     return yaml.safe_dump(doc, sort_keys=False)
 
 
@@ -343,6 +433,24 @@ def from_yaml(text: str) -> JobSpec:
         ),
         suspend=rp.get("suspend", False),
     )
+    ep = spec.get("elasticPolicy")
+    elastic = None
+    if ep is not None:
+        # lenient like the rest of from_yaml: tolerate unknown keys and
+        # accept both snake_case and the reference CRD's camelCase
+        if not isinstance(ep, dict):
+            raise ValidationError("elasticPolicy must be a mapping")
+
+        def _g(snake: str, camel: str, default):
+            return ep.get(snake, ep.get(camel, default))
+
+        elastic = ElasticPolicy(
+            min_replicas=_g("min_replicas", "minReplicas", 1),
+            max_replicas=_g("max_replicas", "maxReplicas", 1),
+            nproc_per_node=_g("nproc_per_node", "nProcPerNode", 1),
+            rdzv_backend=_g("rdzv_backend", "rdzvBackend", "c10d"),
+            max_restarts=_g("max_restarts", "maxRestarts", 3),
+        )
     return JobSpec(
         name=meta.get("name", "job"),
         namespace=meta.get("namespace", "default"),
@@ -350,4 +458,5 @@ def from_yaml(text: str) -> JobSpec:
         replica_specs=replica_specs,
         run_policy=run_policy,
         labels=meta.get("labels", {}),
+        elastic=elastic,
     )

@@ -38,6 +38,19 @@ def pod_name(job: JobSpec, rtype: str, index: int) -> str:
     return f"{job.name}-{rtype.lower()}-{index}"
 
 
+def _global_rank(job: JobSpec, rtype: str, index: int, anchor: str) -> int:
+    """Global process id across ALL replica types, anchor type first then
+    the rest in name order — so every kind forms one world with unique,
+    stable ids (the SURVEY.md §2.8 pod-ordinal contract)."""
+    order = sorted(job.replica_specs, key=lambda rt: (rt != anchor, rt))
+    offset = 0
+    for rt in order:
+        if rt == rtype:
+            break
+        offset += job.replica_specs[rt].replicas
+    return offset + index
+
+
 def _job_selector(job: JobSpec) -> dict[str, str]:
     return {"job-name": job.name, "job-uid": job.uid}
 
@@ -211,22 +224,11 @@ class JobController:
         """Per-kind rendezvous env (the reference's SetClusterSpec equivalent)."""
         coordinator = self.cluster.resolve(job.namespace, job.name)
         if job.kind == "JAXJob":
-            # Global process ids across ALL replica types (Coordinator first),
-            # so a {Coordinator: 1, Worker: N} job forms one N+1-process world
-            # with unique ids — the SURVEY.md §2.8 pod-ordinal contract.
-            order = sorted(
-                job.replica_specs,
-                key=lambda rt: (rt != ReplicaType.COORDINATOR.value, rt),
-            )
-            offset = 0
-            for rt in order:
-                if rt == rtype:
-                    break
-                offset += job.replica_specs[rt].replicas
             env = {
                 "KFT_COORDINATOR": coordinator,
                 "KFT_NUM_PROCESSES": str(job.total_replicas),
-                "KFT_PROCESS_ID": str(offset + index),
+                "KFT_PROCESS_ID": str(_global_rank(
+                    job, rtype, index, ReplicaType.COORDINATOR.value)),
                 "KFT_JOB_NAME": job.name,
                 "KFT_REPLICA_TYPE": rtype,
                 "TPU_WORKER_ID": str(index),
@@ -257,6 +259,39 @@ class JobController:
                         env.setdefault(
                             "KFT_MESH",
                             f"fsdp={w * tpu.chips_per_host}")
+            return env
+        if job.kind in ("PyTorchJob", "XGBoostJob"):
+            # torch.distributed / XGBoost-Rabit contract (reference
+            # SetClusterSpec: pkg/controller.v1/{pytorch,xgboost}/envvar).
+            # Rank 0 is the Master (tracker/store host); global ranks are
+            # Master-first then Workers in (type, index) order.
+            host, _, port = coordinator.rpartition(":")
+            env = {
+                "MASTER_ADDR": host,
+                "MASTER_PORT": port,
+                "WORLD_SIZE": str(job.total_replicas),
+                "RANK": str(_global_rank(
+                    job, rtype, index, ReplicaType.MASTER.value)),
+            }
+            if job.kind == "XGBoostJob":
+                # Rabit tracker flavor: the tracker runs on the Master and
+                # workers learn their count via WORLD_SIZE. WORKER_PORT must
+                # be unique per worker on a shared host (LocalProcessCluster);
+                # with per-pod IPs the fixed convention port suffices.
+                alloc = getattr(self.cluster, "allocate_port", None)
+                env["WORKER_PORT"] = str(
+                    alloc() if alloc else COORDINATOR_PORT + 1)
+            elif job.elastic is not None:
+                # torchrun c10d elastic rendezvous (PET_* is torchrun's
+                # documented env surface)
+                e = job.elastic
+                env.update({
+                    "PET_RDZV_BACKEND": e.rdzv_backend,
+                    "PET_RDZV_ENDPOINT": coordinator,
+                    "PET_NNODES": f"{e.min_replicas}:{e.max_replicas}",
+                    "PET_NPROC_PER_NODE": str(e.nproc_per_node),
+                    "PET_MAX_RESTARTS": str(e.max_restarts),
+                })
             return env
         if job.kind == "TFJob":
             cluster: dict[str, list[str]] = {}
@@ -315,8 +350,8 @@ class JobController:
 
     def _success_anchor(self, job: JobSpec) -> tuple[str, int]:
         """Replica whose success marks job success (reference: chief/worker-0)."""
-        for rt in (ReplicaType.CHIEF.value, ReplicaType.COORDINATOR.value,
-                   ReplicaType.WORKER.value):
+        for rt in (ReplicaType.CHIEF.value, ReplicaType.MASTER.value,
+                   ReplicaType.COORDINATOR.value, ReplicaType.WORKER.value):
             if rt in job.replica_specs:
                 return rt, 0
         return next(iter(job.replica_specs)), 0

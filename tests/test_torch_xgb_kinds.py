@@ -1,0 +1,148 @@
+"""PyTorchJob / XGBoostJob kinds: rendezvous env construction (unit, the
+reference's envvar tests) + a REAL 2-process torch.distributed gloo
+all-reduce e2e on LocalProcessCluster (torch-cpu ships in the env)."""
+
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.api.types import (
+    ConditionType, ElasticPolicy, ValidationError, from_yaml, pytorch_job,
+    to_yaml, validate, xgboost_job,
+)
+from kubeflow_tpu.client import TrainingClient
+from kubeflow_tpu.controller import JobController, LocalProcessCluster
+from kubeflow_tpu.controller.cluster import FakeCluster
+
+
+# ---------------- unit: env construction ----------------
+
+def test_pytorch_env_master_first_ranks():
+    ctl = JobController(FakeCluster())
+    job = ctl.submit(pytorch_job("pt", workers=2))
+    ctl.reconcile("default", "pt")
+    master_env = ctl.cluster_env(job, "Master", 0)
+    w0 = ctl.cluster_env(job, "Worker", 0)
+    w1 = ctl.cluster_env(job, "Worker", 1)
+    assert master_env["RANK"] == "0"
+    assert [w0["RANK"], w1["RANK"]] == ["1", "2"]
+    assert master_env["WORLD_SIZE"] == "3"
+    assert master_env["MASTER_ADDR"] and master_env["MASTER_PORT"]
+    # all replicas agree on the rendezvous point
+    assert (w0["MASTER_ADDR"], w0["MASTER_PORT"]) == (
+        master_env["MASTER_ADDR"], master_env["MASTER_PORT"])
+    assert "PET_RDZV_ENDPOINT" not in w0   # not elastic
+
+
+def test_pytorch_elastic_pet_env_and_yaml_roundtrip():
+    ctl = JobController(FakeCluster())
+    spec = pytorch_job(
+        "pt-el", workers=2, elastic=ElasticPolicy(
+            min_replicas=1, max_replicas=2, nproc_per_node=4))
+    text = to_yaml(spec)
+    spec2 = from_yaml(text)
+    assert spec2.elastic is not None and spec2.elastic.max_replicas == 2
+    job = ctl.submit(spec2)
+    ctl.reconcile("default", "pt-el")
+    env = ctl.cluster_env(job, "Worker", 0)
+    assert env["PET_NNODES"] == "1:2"
+    assert env["PET_NPROC_PER_NODE"] == "4"
+    assert env["PET_RDZV_BACKEND"] == "c10d"
+    assert env["PET_RDZV_ENDPOINT"].count(":") == 1
+
+
+def test_xgboost_env_and_validation():
+    ctl = JobController(FakeCluster())
+    job = ctl.submit(xgboost_job("xgb", workers=2))
+    ctl.reconcile("default", "xgb")
+    env = ctl.cluster_env(job, "Worker", 1)
+    assert env["RANK"] == "2" and env["WORLD_SIZE"] == "3"
+    assert env["WORKER_PORT"]
+    # XGBoostJob requires a Master
+    bad = xgboost_job("xgb2", workers=1)
+    del bad.replica_specs["Master"]
+    with pytest.raises(ValidationError):
+        validate(bad)
+
+
+def test_elastic_rejected_on_jax_kind():
+    from kubeflow_tpu.api.types import jax_job
+
+    job = jax_job("j", workers=1)
+    job.elastic = ElasticPolicy()
+    with pytest.raises(ValidationError):
+        validate(job)
+
+
+def test_master_is_success_anchor():
+    """Master success finishes the job even with workers still running."""
+    from kubeflow_tpu.controller.cluster import PodPhase
+
+    cluster = FakeCluster()
+    ctl = JobController(cluster)
+    ctl.submit(pytorch_job("pt-anchor", workers=1))
+    ctl.reconcile("default", "pt-anchor")
+    cluster.run_scheduled()
+    cluster.set_phase("default", "pt-anchor-master-0", PodPhase.SUCCEEDED, 0)
+    job = ctl.reconcile("default", "pt-anchor")
+    assert job.status.condition() == ConditionType.SUCCEEDED
+
+
+# ---------------- e2e: real torch.distributed gloo ----------------
+
+TORCH_SCRIPT = textwrap.dedent("""
+    import os
+    import torch
+    import torch.distributed as dist
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    dist.init_process_group(
+        "gloo",
+        init_method="tcp://%s:%s" % (
+            os.environ["MASTER_ADDR"], os.environ["MASTER_PORT"]),
+        rank=rank, world_size=world,
+    )
+    t = torch.ones(1) * (rank + 1)
+    dist.all_reduce(t)
+    expected = world * (world + 1) / 2
+    assert t.item() == expected, (t.item(), expected)
+    print("torch world ok rank=%d sum=%g" % (rank, t.item()))
+    dist.destroy_process_group()
+""")
+
+
+def test_pytorchjob_2proc_gloo_allreduce(tmp_path):
+    cluster = LocalProcessCluster(log_dir=str(tmp_path / "pods"))
+    client = TrainingClient(JobController(cluster))
+    try:
+        spec = pytorch_job(
+            "e2e-torch", workers=1,
+            command=[sys.executable, "-c", TORCH_SCRIPT],
+        )
+        client.create_job(spec)
+        done = client.wait_for_job_conditions("e2e-torch", timeout=120)
+        logs = client.get_job_logs("e2e-torch", replica_type="Master")
+        assert done.status.condition() == ConditionType.SUCCEEDED, logs
+        assert "torch world ok rank=0 sum=3" in logs
+    finally:
+        cluster.shutdown()
+
+
+def test_elastic_rejected_on_xgboost_kind():
+    job = xgboost_job("x-el", workers=1)
+    job.elastic = ElasticPolicy()
+    with pytest.raises(ValidationError):
+        validate(job)
+
+
+def test_elastic_camelcase_yaml_accepted():
+    """Reference-CRD camelCase elasticPolicy fields parse leniently."""
+    spec = pytorch_job("pt-cc", workers=1)
+    text = to_yaml(spec).replace(
+        "spec:", "spec:\n  elasticPolicy: {minReplicas: 2, maxReplicas: 4,\n"
+        "    unknownKey: 1}", 1)
+    job = from_yaml(text)
+    assert job.elastic is not None
+    assert (job.elastic.min_replicas, job.elastic.max_replicas) == (2, 4)
