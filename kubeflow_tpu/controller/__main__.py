@@ -98,6 +98,22 @@ def main(argv=None) -> int:
     serving = ServingTicker(
         ServingController(cluster, registry), Autoscaler())
 
+    # notebooks + tensorboards (the CRUD-web-app CR targets) and the
+    # pipelines API server role share this daemon; pipeline lineage goes
+    # through the SAME durable metadata store as HPO, so run state
+    # survives restarts (the persistence-agent role)
+    from kubeflow_tpu.pipelines.client import PipelineClient
+    from kubeflow_tpu.pipelines.runner import LocalRunner
+    from kubeflow_tpu.platform.notebooks import (
+        NotebookController, TensorBoardController,
+    )
+
+    notebooks = NotebookController(cluster)
+    tensorboards = TensorBoardController(cluster)
+    pipelines = PipelineClient(LocalRunner(
+        workdir=os.path.join(cfg.state_dir, "pipelines"),
+        metadata=store.backend))
+
     auth = None
     if args.auth_tokens:
         from kubeflow_tpu.platform.auth import Auth
@@ -107,10 +123,12 @@ def main(argv=None) -> int:
     # the dashboard is part of the single binary: live views over the same
     # controllers this daemon reconciles, scoped by the auth profiles
     from kubeflow_tpu.platform.dashboard import Dashboard
+    from kubeflow_tpu.platform.webui import WebUI
 
     dashboard = Dashboard(
         jobs=controller, experiments=experiments.list,
-        serving=serving.controller,
+        serving=serving.controller, pipelines=pipelines,
+        notebooks=notebooks,
         profiles=auth.profiles if auth is not None else None)
 
     op = Operator(
@@ -125,7 +143,16 @@ def main(argv=None) -> int:
         serving_ticker=serving,
         auth=auth,
         dashboard=dashboard,
+        webui=WebUI(jobs=controller, experiments=experiments,
+                    serving=serving.controller, pipelines=pipelines,
+                    notebooks=notebooks, tensorboards=tensorboards),
     )
+    op.webui.metrics = op.metrics
+    # recurring pipeline runs fire from the serving loop (scheduled-workflow
+    # role; PipelineClient is self-locking and never touches job state) and
+    # idle notebooks are culled under the operator lock (shared cluster)
+    op.serving_tickers += (pipelines.tick,
+                           lambda: op._locked(notebooks.cull_idle))
     tls_cert = tls_key = None
     if args.tls_dir:
         import ipaddress

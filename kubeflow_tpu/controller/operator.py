@@ -86,6 +86,7 @@ class Operator:
         serving_ticker=None,
         auth=None,
         dashboard=None,
+        webui=None,
     ):
         self.controller = controller
         # One lock serializes every compound mutation of controller state
@@ -119,6 +120,11 @@ class Operator:
         # optional platform.dashboard.Dashboard: served at /dashboard
         # (HTML) and /apis/v1/dashboard (JSON), user-scoped when auth is on
         self.dashboard = dashboard
+        # optional platform.webui.WebUI: the browser surface at /ui/*,
+        # sharing the operator lock for its CRUD mutations
+        self.webui = webui
+        if webui is not None and webui._lock is None:
+            webui._lock = self._lock
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -377,13 +383,43 @@ def _make_http_server(op: Operator, port: int,
             pass
 
         def _send(self, code: int, body: str,
-                  ctype: str = "application/json"):
+                  ctype: str = "application/json",
+                  location: Optional[str] = None):
             data = body.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            if location is not None:
+                self.send_header("Location", location)
             self.end_headers()
             self.wfile.write(data)
+
+        def _webui(self, method: str, body: str = ""):
+            """Delegate a /ui request: listings scoped to the caller's
+            profile namespaces, CRUD re-authorized per target namespace."""
+            visible = lambda ns: True          # noqa: E731
+            authz = lambda ns, verb: (True, "")  # noqa: E731
+            if op.auth is not None:
+                user = op.auth.authenticate(
+                    self.headers.get("Authorization"))
+                profiles = getattr(op.auth, "profiles", None)
+                if user not in op.auth.admins and profiles is not None:
+                    allowed = set(profiles.namespaces_for(user))
+                    visible = lambda ns: ns in allowed  # noqa: E731
+
+                def authz(ns, verb):
+                    method = "DELETE" if verb == "delete" else "POST"
+                    res = op.auth.check(
+                        self.headers.get("Authorization"), method, ns)
+                    return res.allowed, res.reason or ""
+
+            resp = op.webui.handle(
+                method, self.path.split("?")[0], body,
+                visible=visible, authz=authz)
+            if resp is None:
+                return self._send(404, '{"error": "unknown path"}')
+            self._send(resp.code, resp.body, resp.ctype,
+                       location=resp.location)
 
         def _resource_path(self, kind: str):
             # /apis/v1/namespaces/{ns}/{kind}[/{name}]
@@ -422,6 +458,9 @@ def _make_http_server(op: Operator, port: int,
                 return self._send(200, op.metrics.render(), "text/plain")
             if not self._authorized():
                 return
+            if op.webui is not None and (
+                    self.path == "/ui" or self.path.startswith("/ui/")):
+                return self._webui("GET")
             if self.path in ("/dashboard", "/apis/v1/dashboard") and \
                     op.dashboard is not None:
                 user = None
@@ -474,6 +513,8 @@ def _make_http_server(op: Operator, port: int,
             body = self.rfile.read(length).decode()
             if not self._authorized():
                 return
+            if op.webui is not None and self.path.startswith("/ui/"):
+                return self._webui("POST", body)
             ns, _ = self._job_path()
             if ns:
                 try:

@@ -1,0 +1,655 @@
+"""Server-rendered web UI for the whole platform.
+
+Capability parity with the reference's browser surfaces (SURVEY.md §2.3
+katib-ui, §2.5 pipelines frontend, §2.6 centraldashboard + CRUD web apps:
+jupyter-web-app / tensorboards-web-app), redesigned for the single-binary
+operator: no JS framework, no separate UI deployments — every page is
+HTML (+ inline SVG for plots and DAGs) rendered from the same in-process
+controller state the daemon reconciles, and CRUD actions are plain HTML
+forms POSTed back to the operator.
+
+Security: every tenant-chosen string that lands in a page is escaped
+(stored-XSS surface), and every mutating route re-checks per-namespace
+authorization through the ``authz`` callback the operator supplies.
+"""
+
+from __future__ import annotations
+
+import html as _html
+import json
+from typing import Callable, Optional
+from urllib.parse import parse_qs
+
+_E = _html.escape
+
+_CSS = """
+body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
+nav{background:#1a2733;padding:.6rem 1rem}
+nav a{color:#cfe3f5;text-decoration:none;margin-right:1.2rem;font-weight:500}
+nav a:hover{color:#fff}
+main{padding:1rem 1.5rem;max-width:70rem}
+table{border-collapse:collapse;margin:.5rem 0 1.2rem;width:100%}
+th,td{border:1px solid #ddd;padding:.35rem .6rem;text-align:left;
+font-size:.9rem}
+th{background:#eef2f5}
+h1{font-size:1.3rem}h2{font-size:1.05rem;margin-top:1.4rem}
+.ok{color:#1a7f37}.bad{color:#b42318}.warn{color:#9a6700}
+form.inline{display:inline}
+input,select{margin:.15rem .3rem .15rem 0;padding:.2rem .35rem}
+button{padding:.25rem .7rem;cursor:pointer}
+svg{background:#fff;border:1px solid #ddd}
+code,pre{background:#f1f3f5;padding:.1rem .3rem;border-radius:3px}
+pre{padding:.6rem;overflow-x:auto}
+.pill{display:inline-block;padding:.05rem .5rem;border-radius:999px;
+background:#e7ecf0;font-size:.85rem}
+"""
+
+_NAV = (
+    ("/ui", "Overview"), ("/ui/jobs", "Jobs"),
+    ("/ui/experiments", "Experiments"), ("/ui/serving", "Serving"),
+    ("/ui/pipelines", "Pipelines"), ("/ui/notebooks", "Notebooks"),
+)
+
+
+def _state_cls(state: str) -> str:
+    if state in ("Succeeded", "Running", "Cached", "True"):
+        return "ok"
+    if state in ("Failed", "Killed"):
+        return "bad"
+    return "warn"
+
+
+def _pill(state) -> str:
+    s = str(getattr(state, "value", state))
+    return f'<span class="pill {_state_cls(s)}">{_E(s)}</span>'
+
+
+class Response:
+    def __init__(self, code: int, body: str, ctype: str = "text/html",
+                 location: Optional[str] = None):
+        self.code = code
+        self.body = body
+        self.ctype = ctype
+        self.location = location
+
+
+def _redirect(to: str) -> Response:
+    return Response(303, "", location=to)
+
+
+def _not_found(what: str = "page") -> Response:
+    return Response(404, f"<h1>404</h1><p>{_E(what)} not found</p>")
+
+
+class WebUI:
+    """Renders the platform's browser surfaces from live controller state.
+
+    ``authz(namespace, verb) -> (allowed, reason)`` gates every mutation;
+    ``visible(namespace) -> bool`` scopes listings per user (both default
+    to open when the operator runs without auth). ``lock`` (the operator's
+    RLock) serializes mutations with the reconcile loops."""
+
+    def __init__(self, *, jobs=None, experiments=None, serving=None,
+                 pipelines=None, notebooks=None, tensorboards=None,
+                 metrics=None, lock=None):
+        self.jobs = jobs                    # JobController
+        self.experiments = experiments      # ExperimentManager
+        self.serving = serving              # ServingController
+        self.pipelines = pipelines          # PipelineClient
+        self.notebooks = notebooks          # NotebookController
+        self.tensorboards = tensorboards    # TensorBoardController
+        self.metrics = metrics              # operator Metrics (optional)
+        self._lock = lock
+
+    # ---------------- routing ----------------
+
+    def handle(self, method: str, path: str, body: str = "",
+               visible: Optional[Callable[[str], bool]] = None,
+               authz: Optional[Callable[[str, str], tuple[bool, str]]] = None,
+               ) -> Optional[Response]:
+        """Route one request. Returns None for non-/ui paths."""
+        if path != "/ui" and not path.startswith("/ui/"):
+            return None
+        vis = visible or (lambda ns: True)
+        can = authz or (lambda ns, verb: (True, ""))
+        parts = [p for p in path.split("/") if p][1:]   # drop leading 'ui'
+        try:
+            if method == "GET":
+                return self._route_get(parts, vis)
+            if method == "POST":
+                return self._route_post(parts, parse_qs(body), can)
+        except Exception as e:   # render, never 500 with a stack trace
+            return Response(400, f"<h1>error</h1><pre>{_E(str(e))}</pre>")
+        return _not_found()
+
+    def _route_get(self, parts: list[str], vis) -> Response:
+        if not parts:
+            return self._page("Overview", self.overview(vis))
+        head = parts[0]
+        # detail routes enforce the SAME namespace scoping as listings: a
+        # direct URL into a foreign namespace must leak nothing (specs
+        # carry env vars), so invisible renders exactly like nonexistent
+        if head == "jobs":
+            if len(parts) == 3:
+                if not vis(parts[1]):
+                    return self._page(f"Job {parts[2]}", "<p>not found</p>")
+                return self._page(
+                    f"Job {parts[2]}", self.job_detail(parts[1], parts[2]))
+            return self._page("Jobs", self.jobs_list(vis))
+        if head == "experiments":
+            if len(parts) == 3:
+                if not vis(parts[1]):
+                    return self._page(
+                        f"Experiment {parts[2]}", "<p>not found</p>")
+                return self._page(
+                    f"Experiment {parts[2]}",
+                    self.experiment_detail(parts[1], parts[2]))
+            return self._page("Experiments", self.experiments_list(vis))
+        if head == "serving":
+            return self._page("Serving", self.serving_list(vis))
+        if head == "pipelines":
+            if len(parts) == 3 and parts[1] == "runs":
+                return self._page(
+                    f"Run {parts[2]}", self.run_detail(parts[2]))
+            return self._page("Pipelines", self.pipelines_list())
+        if head == "notebooks":
+            return self._page("Notebooks", self.notebooks_list(vis))
+        return _not_found()
+
+    def _route_post(self, parts: list[str], form: dict, can) -> Response:
+        def field(name: str, default: str = "") -> str:
+            return (form.get(name) or [default])[0].strip()
+
+        if len(parts) != 3 or parts[0] not in ("notebooks", "tensorboards"):
+            return _not_found("action")
+        kind, ns, action = parts
+        allowed, reason = can(ns, "create" if action == "create" else "delete")
+        if not allowed:
+            return Response(403, f"<h1>403</h1><p>{_E(reason)}</p>")
+        name = field("name")
+        if not name or not name.replace("-", "").replace(".", "").isalnum():
+            return Response(400, f"<h1>400</h1><p>invalid name {_E(name)!s}</p>")
+
+        def mutate():
+            if kind == "notebooks":
+                from kubeflow_tpu.platform.notebooks import Notebook
+
+                if self.notebooks is None:
+                    raise LookupError("notebooks controller not wired")
+                if action == "create":
+                    nb = Notebook(name=name, namespace=ns)
+                    if field("image"):
+                        nb.image = field("image")
+                    if field("cull_idle_seconds"):
+                        nb.cull_idle_seconds = float(
+                            field("cull_idle_seconds"))
+                    self.notebooks.apply(nb)
+                elif action == "delete":
+                    self.notebooks.delete(ns, name)
+                elif action == "touch":
+                    self.notebooks.touch(ns, name)
+                else:
+                    raise LookupError(f"unknown action {action}")
+            else:
+                from kubeflow_tpu.platform.notebooks import TensorBoard
+
+                if self.tensorboards is None:
+                    raise LookupError("tensorboard controller not wired")
+                if action == "create":
+                    self.tensorboards.apply(TensorBoard(
+                        name=name, namespace=ns, logdir=field("logdir")))
+                elif action == "delete":
+                    self.tensorboards.delete(ns, name)
+                else:
+                    raise LookupError(f"unknown action {action}")
+
+        if self._lock is not None:
+            with self._lock:
+                mutate()
+        else:
+            mutate()
+        return _redirect("/ui/notebooks")
+
+    # ---------------- layout ----------------
+
+    @staticmethod
+    def _page(title: str, content: str) -> Response:
+        nav = "".join(f'<a href="{href}">{label}</a>'
+                      for href, label in _NAV)
+        return Response(200, (
+            "<!doctype html><html><head>"
+            f"<title>{_E(title)} — kubeflow-tpu</title>"
+            f"<style>{_CSS}</style></head><body>"
+            f"<nav>{nav}</nav><main><h1>{_E(title)}</h1>{content}"
+            "</main></body></html>"))
+
+    # ---------------- overview ----------------
+
+    def overview(self, vis) -> str:
+        cards = []
+
+        def card(label: str, n: int, href: str):
+            cards.append(
+                f'<tr><td><a href="{href}">{_E(label)}</a></td>'
+                f"<td>{n}</td></tr>")
+
+        if self.jobs is not None:
+            card("Training jobs",
+                 sum(1 for (ns, _) in self.jobs.jobs if vis(ns)), "/ui/jobs")
+        if self.experiments is not None:
+            card("Experiments",
+                 sum(1 for e in self.experiments.list() if vis(e.namespace)),
+                 "/ui/experiments")
+        if self.serving is not None:
+            card("InferenceServices",
+                 sum(1 for (ns, _) in self.serving.services if vis(ns)),
+                 "/ui/serving")
+        if self.pipelines is not None:
+            card("Pipeline runs", len(self.pipelines.list_runs()),
+                 "/ui/pipelines")
+        if self.notebooks is not None:
+            card("Notebooks",
+                 sum(1 for (ns, _) in self.notebooks.notebooks if vis(ns)),
+                 "/ui/notebooks")
+        out = ("<table><tr><th>Resource</th><th>Count</th></tr>"
+               + "".join(cards) + "</table>")
+        if self.metrics is not None:
+            interesting = (
+                "kft_jobs_registered", "kft_gang_queue_depth",
+                "kft_jobs_submitted_total", "kft_reconcile_total")
+            rows = "".join(
+                f"<tr><td><code>{_E(k)}</code></td><td>{v:g}</td></tr>"
+                for k in interesting
+                for v in [self.metrics.get(k)] if v is not None)
+            if rows:
+                out += ("<h2>Controller metrics</h2><table>"
+                        "<tr><th>Metric</th><th>Value</th></tr>"
+                        f"{rows}</table>")
+        return out
+
+    # ---------------- jobs ----------------
+
+    def jobs_list(self, vis) -> str:
+        if self.jobs is None:
+            return "<p>job controller not wired</p>"
+        rows = []
+        for (ns, name), job in sorted(self.jobs.jobs.items()):
+            if not vis(ns):
+                continue
+            cond = job.status.condition()
+            rows.append(
+                f"<tr><td>{_E(ns)}</td>"
+                f'<td><a href="/ui/jobs/{_E(ns)}/{_E(name)}">{_E(name)}</a>'
+                f"</td><td>{_E(job.kind)}</td>"
+                f"<td>{_pill(cond.value if cond else 'Pending')}</td>"
+                f"<td>{job.status.restart_count}</td></tr>")
+        return ("<table><tr><th>Namespace</th><th>Name</th><th>Kind</th>"
+                "<th>State</th><th>Restarts</th></tr>"
+                + "".join(rows) + "</table>")
+
+    def job_detail(self, ns: str, name: str) -> str:
+        job = self.jobs.get(ns, name) if self.jobs is not None else None
+        if job is None:
+            return "<p>not found</p>"
+        conds = "".join(
+            f"<tr><td>{_pill(c.type.value)}</td><td>{_E(c.reason)}</td>"
+            f"<td>{_E(c.message)}</td></tr>"
+            for c in job.status.conditions)
+        reps = "".join(
+            f"<tr><td>{_E(rt)}</td><td>{rs.active}</td><td>{rs.succeeded}"
+            f"</td><td>{rs.failed}</td></tr>"
+            for rt, rs in job.status.replica_statuses.items())
+        from kubeflow_tpu.api.types import to_yaml
+
+        return (
+            f"<p>kind <code>{_E(job.kind)}</code> · uid "
+            f"<code>{_E(job.uid)}</code> · restarts "
+            f"{job.status.restart_count}</p>"
+            "<h2>Conditions</h2><table><tr><th>Type</th><th>Reason</th>"
+            f"<th>Message</th></tr>{conds}</table>"
+            "<h2>Replicas</h2><table><tr><th>Type</th><th>Active</th>"
+            f"<th>Succeeded</th><th>Failed</th></tr>{reps}</table>"
+            f"<h2>Spec</h2><pre>{_E(to_yaml(job))}</pre>")
+
+    # ---------------- experiments (katib-ui role) ----------------
+
+    def experiments_list(self, vis) -> str:
+        if self.experiments is None:
+            return "<p>experiment manager not wired</p>"
+        rows = []
+        for e in self.experiments.list():
+            if not vis(e.namespace):
+                continue
+            state = ("Succeeded" if e.succeeded
+                     else "Failed" if e.failed else "Running")
+            best = e.best_trial
+            rows.append(
+                f"<tr><td>{_E(e.namespace)}</td>"
+                f'<td><a href="/ui/experiments/{_E(e.namespace)}/{_E(e.name)}">'
+                f"{_E(e.name)}</a></td><td>{_pill(state)}</td>"
+                f"<td>{len(e.trials)}/{e.max_trial_count}</td>"
+                f"<td>{'' if best is None else f'{best.objective_value:.6g}'}"
+                "</td></tr>")
+        return ("<table><tr><th>Namespace</th><th>Name</th><th>State</th>"
+                "<th>Trials</th><th>Best objective</th></tr>"
+                + "".join(rows) + "</table>")
+
+    def experiment_detail(self, ns: str, name: str) -> str:
+        exp = (self.experiments.get(ns, name)
+               if self.experiments is not None else None)
+        if exp is None:
+            return "<p>not found</p>"
+        best = exp.best_trial
+        rows = []
+        for t in exp.trials:
+            is_best = best is not None and t.name == best.name
+            rows.append(
+                f"<tr><td>{_E(t.name)}{' ★' if is_best else ''}</td>"
+                f"<td>{_pill(t.state.value)}</td>"
+                f"<td><code>{_E(json.dumps(t.parameters))}</code></td>"
+                f"<td>{'' if t.objective_value is None else f'{t.objective_value:.6g}'}"
+                "</td></tr>")
+        obj = exp.objective
+        return (
+            f"<p>algorithm <code>{_E(exp.algorithm.name)}</code> · objective "
+            f"<code>{_E(obj.goal_type.value)} {_E(obj.metric_name)}</code>"
+            + (f" · goal {obj.goal:g}" if obj.goal is not None else "")
+            + (f" · done ({_E(exp.completion_reason)})"
+               if exp.succeeded or exp.failed else "")
+            + "</p>"
+            + self._objective_svg(exp)
+            + "<h2>Trials</h2><table><tr><th>Trial</th><th>State</th>"
+            f"<th>Parameters</th><th>Objective</th></tr>{''.join(rows)}"
+            "</table>")
+
+    @staticmethod
+    def _objective_svg(exp) -> str:
+        """Objective-vs-trial scatter with a running-best line — the
+        katib-ui experiment plot, as dependency-free inline SVG."""
+        pts = [(i, t.objective_value) for i, t in enumerate(exp.trials)
+               if t.objective_value is not None]
+        if len(pts) < 1:
+            return ""
+        w, h, pad = 640, 220, 36
+        ys = [y for _, y in pts]
+        lo, hi = min(ys), max(ys)
+        if hi - lo < 1e-12:
+            lo, hi = lo - 0.5, hi + 0.5
+        n = max(1, len(exp.trials) - 1)
+
+        def sx(i):
+            return pad + (w - 2 * pad) * (i / n)
+
+        def sy(v):
+            return h - pad - (h - 2 * pad) * ((v - lo) / (hi - lo))
+
+        circles = "".join(
+            f'<circle cx="{sx(i):.1f}" cy="{sy(y):.1f}" r="3.5" '
+            'fill="#2563eb" fill-opacity="0.8"/>' for i, y in pts)
+        # running best (respecting the objective direction)
+        best_path, cur = [], None
+        for i, y in pts:
+            if cur is None or exp.objective.better(y, cur):
+                cur = y
+            best_path.append(f"{sx(i):.1f},{sy(cur):.1f}")
+        line = (f'<polyline points="{" ".join(best_path)}" fill="none" '
+                'stroke="#16a34a" stroke-width="1.5"/>') if best_path else ""
+        axis = (
+            f'<line x1="{pad}" y1="{h-pad}" x2="{w-pad}" y2="{h-pad}" '
+            'stroke="#888"/>'
+            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{h-pad}" '
+            'stroke="#888"/>'
+            f'<text x="{pad}" y="{pad-8}" font-size="11" fill="#555">'
+            f"{hi:.4g}</text>"
+            f'<text x="{pad}" y="{h-pad+14}" font-size="11" fill="#555">'
+            f"{lo:.4g}</text>"
+            f'<text x="{w-pad-40}" y="{h-pad+14}" font-size="11" '
+            f'fill="#555">trial {len(exp.trials)-1}</text>')
+        return (f'<svg width="{w}" height="{h}" viewBox="0 0 {w} {h}" '
+                'role="img" aria-label="objective per trial">'
+                f"{axis}{line}{circles}</svg>")
+
+    # ---------------- serving ----------------
+
+    def serving_list(self, vis) -> str:
+        if self.serving is None:
+            return "<p>serving controller not wired</p>"
+        rows = []
+        for (ns, name), isvc in sorted(self.serving.services.items()):
+            if not vis(ns):
+                continue
+            traffic = ", ".join(
+                f"{_E(str(rev))}: {pct}%"
+                for rev, pct in isvc.status.traffic.items())
+            rows.append(
+                f"<tr><td>{_E(ns)}</td><td>{_E(name)}</td>"
+                f"<td>{_pill('True' if isvc.status.ready else 'False')}</td>"
+                f"<td>{_E(isvc.status.latest_revision or '')}</td>"
+                f"<td>{traffic}</td>"
+                f"<td><code>{_E(isvc.status.url or '')}</code></td></tr>")
+        return ("<table><tr><th>Namespace</th><th>Name</th><th>Ready</th>"
+                "<th>Latest revision</th><th>Traffic</th><th>URL</th></tr>"
+                + "".join(rows) + "</table>")
+
+    # ---------------- pipelines (frontend role) ----------------
+
+    def pipelines_list(self) -> str:
+        if self.pipelines is None:
+            return "<p>pipeline client not wired</p>"
+        pipes = "".join(f"<li><code>{_E(p)}</code></li>"
+                        for p in self.pipelines.list_pipelines())
+        runs = "".join(
+            f'<tr><td><a href="/ui/pipelines/runs/{_E(r.run_id)}">'
+            f"{_E(r.run_id)}</a></td><td>{_pill(r.state)}</td>"
+            f"<td>{len(r.tasks)}</td></tr>"
+            for r in self.pipelines.list_runs())
+        rec = "".join(
+            f"<tr><td>{_E(rr.name)}</td><td><code>{_E(rr.pipeline)}</code>"
+            f"</td><td>{rr.interval_seconds:g}s</td>"
+            f"<td>{'yes' if rr.enabled else 'no'}</td>"
+            f"<td>{len(rr.run_ids)}</td></tr>"
+            for rr in getattr(self.pipelines, "_recurring", {}).values())
+        return (
+            f"<h2>Pipelines</h2><ul>{pipes or '<li>none uploaded</li>'}</ul>"
+            "<h2>Runs</h2><table><tr><th>Run</th><th>State</th>"
+            f"<th>Tasks</th></tr>{runs}</table>"
+            "<h2>Recurring runs</h2><table><tr><th>Name</th><th>Pipeline</th>"
+            f"<th>Interval</th><th>Enabled</th><th>Fired</th></tr>{rec}"
+            "</table>")
+
+    def run_detail(self, run_id: str) -> str:
+        run = (self.pipelines.get_run(run_id)
+               if self.pipelines is not None else None)
+        if run is None:
+            return "<p>not found</p>"
+        rows = "".join(
+            f"<tr><td>{_E(t.name)}</td><td>{_pill(t.state)}</td>"
+            f"<td>{t.attempts}</td>"
+            f"<td><code>{_E(json.dumps(t.outputs, default=str)[:200])}</code>"
+            f"</td><td>{_E(t.error[:200])}</td></tr>"
+            for t in run.tasks.values())
+        return (
+            f"<p>state {_pill(run.state)} · params "
+            f"<code>{_E(json.dumps(run.params, default=str))}</code></p>"
+            + self._dag_svg(run)
+            + "<h2>Tasks</h2><table><tr><th>Task</th><th>State</th>"
+            f"<th>Attempts</th><th>Outputs</th><th>Error</th></tr>{rows}"
+            "</table>")
+
+    def _dag_svg(self, run) -> str:
+        """Run DAG as inline SVG: nodes colored by state, edges from the
+        uploaded pipeline's task graph (explicit .after deps + data deps)."""
+        edges = self._run_edges(run)
+        names = list(run.tasks)
+        if not names:
+            return ""
+        # topological layering by longest path from a root
+        depth = {n: 0 for n in names}
+        for _ in range(len(names)):
+            changed = False
+            for src, dst in edges:
+                if src in depth and dst in depth \
+                        and depth[dst] < depth[src] + 1:
+                    depth[dst] = depth[src] + 1
+                    changed = True
+            if not changed:
+                break
+        layers: dict[int, list[str]] = {}
+        for n in names:
+            layers.setdefault(depth[n], []).append(n)
+        box_w, box_h, gap_x, gap_y, pad = 150, 34, 40, 28, 20
+        n_layers = max(layers) + 1
+        max_rows = max(len(v) for v in layers.values())
+        w = pad * 2 + n_layers * box_w + (n_layers - 1) * gap_x
+        h = pad * 2 + max_rows * box_h + (max_rows - 1) * gap_y
+        pos = {}
+        for d, members in layers.items():
+            for r, n in enumerate(sorted(members)):
+                x = pad + d * (box_w + gap_x)
+                y = pad + r * (box_h + gap_y)
+                pos[n] = (x, y)
+        fill = {"Succeeded": "#dcfce7", "Cached": "#dbeafe",
+                "Failed": "#fee2e2", "Running": "#fef9c3",
+                "Skipped": "#e5e7eb", "Pending": "#f3f4f6"}
+        parts = ['<defs><marker id="arr" viewBox="0 0 10 10" refX="9" '
+                 'refY="5" markerWidth="7" markerHeight="7" orient="auto">'
+                 '<path d="M0,0L10,5L0,10z" fill="#94a3b8"/></marker></defs>']
+        for src, dst in edges:
+            if src not in pos or dst not in pos:
+                continue
+            x1, y1 = pos[src][0] + box_w, pos[src][1] + box_h / 2
+            x2, y2 = pos[dst][0], pos[dst][1] + box_h / 2
+            parts.append(
+                f'<line x1="{x1}" y1="{y1}" x2="{x2}" y2="{y2}" '
+                'stroke="#94a3b8" stroke-width="1.2" marker-end="url(#arr)"/>')
+        for n, (x, y) in pos.items():
+            state = str(run.tasks[n].state.value)
+            parts.append(
+                f'<rect x="{x}" y="{y}" width="{box_w}" height="{box_h}" '
+                f'rx="6" fill="{fill.get(state, "#f3f4f6")}" '
+                'stroke="#64748b"/>'
+                f'<text x="{x + box_w / 2}" y="{y + box_h / 2 + 4}" '
+                'text-anchor="middle" font-size="11">'
+                f"{_E(n[:22])}</text>")
+        return (f'<svg width="{w}" height="{h}" viewBox="0 0 {w} {h}" '
+                f'role="img" aria-label="run DAG">{"".join(parts)}</svg>')
+
+    def _run_edges(self, run) -> list[tuple[str, str]]:
+        """Edges between the run's expanded task instances, derived from
+        the pipeline graph (instance names are '<task>' or '<task>-<i>...'
+        for loop iterations)."""
+        from kubeflow_tpu.pipelines import dsl
+
+        # the run's context records its pipeline name authoritatively
+        # (runner.run put_context properties); fall back to the longest
+        # name prefix for stores that predate that record
+        pipe = None
+        meta = getattr(self.pipelines.runner, "metadata", None)
+        if meta is not None:
+            ctx_rec = meta.context_by_name("pipeline_run", run.run_id)
+            if ctx_rec is not None:
+                pipe = self.pipelines._pipelines.get(
+                    ctx_rec.properties.get("pipeline"))
+        if pipe is None:
+            for pname in sorted(self.pipelines.list_pipelines(),
+                                key=len, reverse=True):
+                if run.run_id == pname or \
+                        run.run_id.startswith(pname + "-"):
+                    pipe = self.pipelines._pipelines[pname]
+                    break
+        if pipe is None:
+            return []
+        try:
+            ctx = pipe.trace(dict(run.params))
+        except Exception:
+            return []
+        base_edges = set()
+        for t in ctx.tasks.values():
+            for dep in t.dependencies:
+                base_edges.add((dep, t.name))
+            for v in t.arguments.values():
+                for ref in _refs(v, dsl.OutputRef):
+                    base_edges.add((ref.task, t.name))
+            for cond in t.conditions:
+                for ref in _refs((cond.left, cond.right), dsl.OutputRef):
+                    base_edges.add((ref.task, t.name))
+
+        def instances(base: str) -> list[str]:
+            return [n for n in run.tasks
+                    if n == base or n.startswith(base + "-")]
+
+        out = []
+        for src, dst in sorted(base_edges):
+            for s in instances(src):
+                for d in instances(dst):
+                    out.append((s, d))
+        return out
+
+    # ---------------- notebooks + tensorboards (CRUD web apps) ----------
+
+    def notebooks_list(self, vis) -> str:
+        out = []
+        if self.notebooks is not None:
+            rows = "".join(
+                f"<tr><td>{_E(ns)}</td><td>{_E(name)}</td>"
+                f"<td>{_E(nb.image)}</td>"
+                f"<td>{_pill('Stopped' if nb.stopped else 'Running')}</td>"
+                "<td>"
+                f'<form class="inline" method="post" '
+                f'action="/ui/notebooks/{_E(ns)}/touch">'
+                f'<input type="hidden" name="name" value="{_E(name)}">'
+                "<button>connect</button></form> "
+                f'<form class="inline" method="post" '
+                f'action="/ui/notebooks/{_E(ns)}/delete">'
+                f'<input type="hidden" name="name" value="{_E(name)}">'
+                "<button>delete</button></form></td></tr>"
+                for (ns, name), nb in sorted(self.notebooks.notebooks.items())
+                if vis(ns))
+            out.append(
+                "<h2>Notebooks</h2><table><tr><th>Namespace</th>"
+                "<th>Name</th><th>Image</th><th>State</th><th></th></tr>"
+                f"{rows}</table>"
+                '<form method="post" action="/ui/notebooks/default/create" '
+                'onsubmit="this.action=\'/ui/notebooks/\'+'
+                "this.ns.value+'/create'\">"
+                '<input name="ns" value="default" size="10">'
+                '<input name="name" placeholder="name" required>'
+                '<input name="image" placeholder="image (optional)">'
+                '<input name="cull_idle_seconds" placeholder="cull secs" '
+                'size="8"><button>Create notebook</button></form>')
+        if self.tensorboards is not None:
+            rows = "".join(
+                f"<tr><td>{_E(ns)}</td><td>{_E(name)}</td>"
+                f"<td><code>{_E(tb.logdir)}</code></td>"
+                "<td>"
+                f'<form class="inline" method="post" '
+                f'action="/ui/tensorboards/{_E(ns)}/delete">'
+                f'<input type="hidden" name="name" value="{_E(name)}">'
+                "<button>delete</button></form></td></tr>"
+                for (ns, name), tb in sorted(self.tensorboards.boards.items())
+                if vis(ns))
+            out.append(
+                "<h2>TensorBoards</h2><table><tr><th>Namespace</th>"
+                "<th>Name</th><th>Logdir</th><th></th></tr>"
+                f"{rows}</table>"
+                '<form method="post" '
+                'action="/ui/tensorboards/default/create" '
+                'onsubmit="this.action=\'/ui/tensorboards/\'+'
+                "this.ns.value+'/create'\">"
+                '<input name="ns" value="default" size="10">'
+                '<input name="name" placeholder="name" required>'
+                '<input name="logdir" placeholder="logdir">'
+                "<button>Create tensorboard</button></form>")
+        return "".join(out) or "<p>no notebook controllers wired</p>"
+
+
+def _refs(v, ref_type):
+    """Yield every OutputRef nested in a task-argument value."""
+    if isinstance(v, ref_type):
+        yield v
+    elif isinstance(v, dict):
+        for x in v.values():
+            yield from _refs(x, ref_type)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _refs(x, ref_type)

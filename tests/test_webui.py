@@ -1,0 +1,274 @@
+"""Web UI layer: the browser surfaces the reference ships as separate apps
+(katib-ui, pipelines frontend, centraldashboard, jupyter/tensorboards CRUD
+web apps) rendered server-side from live controller state, plus the
+operator-mounted /ui routes with auth scoping."""
+
+import json
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api.types import jax_job
+from kubeflow_tpu.controller import JobController, Operator
+from kubeflow_tpu.controller.cluster import FakeCluster
+from kubeflow_tpu.hpo.types import (
+    Experiment, ObjectiveGoalType, ObjectiveSpec, ParameterSpec,
+    ParameterType, Trial, TrialState,
+)
+from kubeflow_tpu.platform.notebooks import (
+    NotebookController, TensorBoardController,
+)
+from kubeflow_tpu.platform.webui import WebUI
+
+
+def _experiment_with_trials():
+    exp = Experiment(
+        name="sweep",
+        parameters=[ParameterSpec("lr", ParameterType.DOUBLE, min=1e-5,
+                                  max=1e-1)],
+        objective=ObjectiveSpec(goal_type=ObjectiveGoalType.MINIMIZE,
+                                metric_name="loss"),
+    )
+    for i, v in enumerate([3.0, 2.1, 2.6, 1.4]):
+        exp.trials.append(Trial(
+            name=f"sweep-{i}", parameters={"lr": 10 ** -(i + 1)},
+            state=TrialState.SUCCEEDED, objective_value=v))
+    return exp
+
+
+def _stub_experiments(exp):
+    return types.SimpleNamespace(
+        list=lambda: [exp],
+        get=lambda ns, name: exp if (ns, name) == (exp.namespace, exp.name)
+        else None)
+
+
+@pytest.fixture()
+def ui():
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+    jobs.submit(jax_job("train-1", workers=2))
+    jobs.reconcile("default", "train-1")
+    exp = _experiment_with_trials()
+    return WebUI(
+        jobs=jobs,
+        experiments=_stub_experiments(exp),
+        notebooks=NotebookController(cluster),
+        tensorboards=TensorBoardController(cluster),
+    )
+
+
+def get(ui, path):
+    resp = ui.handle("GET", path)
+    assert resp is not None and resp.code == 200, (path, resp and resp.code)
+    return resp.body
+
+
+def test_overview_counts_and_links(ui):
+    body = get(ui, "/ui")
+    assert "Training jobs" in body and "/ui/jobs" in body
+    assert "Experiments" in body
+
+
+def test_jobs_list_and_detail(ui):
+    body = get(ui, "/ui/jobs")
+    assert "train-1" in body and "JAXJob" in body
+    detail = get(ui, "/ui/jobs/default/train-1")
+    assert "Conditions" in detail and "Created" in detail
+    assert "replicas: 2" in detail        # YAML spec is on the page
+    missing = ui.handle("GET", "/ui/jobs/default/nope")
+    assert "not found" in missing.body
+
+
+def test_experiment_detail_has_svg_plot_and_best(ui):
+    body = get(ui, "/ui/experiments")
+    assert "sweep" in body
+    detail = get(ui, "/ui/experiments/default/sweep")
+    assert "<svg" in detail and "circle" in detail    # objective plot
+    assert "★" in detail                              # best-trial marker
+    assert "sweep-3" in detail
+
+
+def test_notebook_crud_roundtrip(ui):
+    resp = ui.handle("POST", "/ui/notebooks/default/create",
+                     "name=nb1&image=jupyter%2Fbase&cull_idle_seconds=60")
+    assert resp.code == 303 and resp.location == "/ui/notebooks"
+    nb = ui.notebooks.notebooks[("default", "nb1")]
+    assert nb.image == "jupyter/base" and nb.cull_idle_seconds == 60.0
+    body = get(ui, "/ui/notebooks")
+    assert "nb1" in body and "jupyter/base" in body
+    resp = ui.handle("POST", "/ui/notebooks/default/delete", "name=nb1")
+    assert resp.code == 303
+    assert ("default", "nb1") not in ui.notebooks.notebooks
+
+
+def test_tensorboard_create_and_escaping(ui):
+    # logdir is tenant-chosen free text: it must come back escaped
+    resp = ui.handle("POST", "/ui/tensorboards/default/create",
+                     "name=tb1&logdir=%3Cscript%3Ealert(1)%3C%2Fscript%3E")
+    assert resp.code == 303
+    body = get(ui, "/ui/notebooks")
+    assert "<script>alert" not in body
+    assert "&lt;script&gt;" in body
+
+
+def test_create_rejects_bad_name(ui):
+    resp = ui.handle("POST", "/ui/notebooks/default/create",
+                     "name=../etc/passwd")
+    assert resp.code == 400
+    assert not ui.notebooks.notebooks
+
+
+def test_authz_callback_gates_writes(ui):
+    denied = ui.handle(
+        "POST", "/ui/notebooks/team-a/create", "name=nb2",
+        authz=lambda ns, verb: (False, f"no {verb} in {ns}"))
+    assert denied.code == 403 and "no create in team-a" in denied.body
+    assert not ui.notebooks.notebooks
+
+
+def test_visibility_scopes_listings(ui):
+    body = ui.handle("GET", "/ui/jobs",
+                     visible=lambda ns: ns == "elsewhere").body
+    assert "train-1" not in body
+
+
+# ---------------- pipelines frontend ----------------
+
+def _pipeline_run(tmp_path):
+    from kubeflow_tpu.pipelines import dsl
+    from kubeflow_tpu.pipelines.client import PipelineClient
+    from kubeflow_tpu.pipelines.runner import LocalRunner
+
+    @dsl.component
+    def make(x: int) -> int:
+        return x + 1
+
+    @dsl.component
+    def double(v: int) -> int:
+        return v * 2
+
+    @dsl.pipeline(name="demo")
+    def demo(x: int = 1):
+        a = make(x=x)
+        double(v=a.output)
+
+    client = PipelineClient(LocalRunner(workdir=str(tmp_path / "wd")))
+    client.upload_pipeline(demo)
+    run = client.create_run("demo", arguments={"x": 3})
+    return client, run
+
+
+def test_pipeline_run_dag_svg(tmp_path):
+    client, run = _pipeline_run(tmp_path)
+    ui = WebUI(pipelines=client)
+    body = get(ui, "/ui/pipelines")
+    assert "demo" in body and run.run_id in body
+    detail = get(ui, f"/ui/pipelines/runs/{run.run_id}")
+    assert "<svg" in detail and "<rect" in detail
+    assert "marker-end" in detail          # at least one DAG edge
+    assert "make" in detail and "double" in detail
+    assert detail.count("Succeeded") >= 2
+
+
+# ---------------- operator-mounted /ui with auth ----------------
+
+def _fetch(url, token=None, method="GET", data=None):
+    req = urllib.request.Request(url, method=method, data=data)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    return urllib.request.urlopen(req)
+
+
+def test_operator_serves_ui_with_auth(tmp_path):
+    from kubeflow_tpu.platform.auth import Auth
+    from kubeflow_tpu.platform.profiles import Profile, ProfileController
+
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+    profiles = ProfileController()
+    profiles.apply(Profile(name="team-a", owner="alice@x.io"))
+    profiles.apply(Profile(name="team-b", owner="bob@x.io"))
+    auth = Auth(tokens={"tok-a": "alice@x.io", "tok-b": "bob@x.io"},
+                profiles=profiles)
+    ui = WebUI(jobs=jobs, notebooks=NotebookController(cluster))
+    op = Operator(jobs, reconcile_period=0.05, auth=auth, webui=ui)
+    port = op.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        jobs.submit(jax_job("a-job", workers=1, namespace="team-a"))
+        jobs.submit(jax_job("b-job", workers=1, namespace="team-b"))
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _fetch(f"{base}/ui/jobs")
+        assert e.value.code == 401
+
+        body = _fetch(f"{base}/ui/jobs", token="tok-a").read().decode()
+        assert "a-job" in body and "b-job" not in body
+
+        # bob cannot create a notebook in alice's namespace
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _fetch(f"{base}/ui/notebooks/team-a/create", token="tok-b",
+                   method="POST", data=b"name=nb")
+        assert e.value.code == 403
+
+        # alice can; the POST redirects back to the listing
+        req = urllib.request.Request(
+            f"{base}/ui/notebooks/team-a/create", method="POST",
+            data=b"name=nb")
+        req.add_header("Authorization", "Bearer tok-a")
+        resp = urllib.request.urlopen(req)   # follows the 303
+        assert resp.status == 200
+        assert ("team-a", "nb") in ui.notebooks.notebooks
+    finally:
+        op.stop()
+
+
+def test_detail_routes_enforce_visibility(ui):
+    """A direct detail URL into a foreign namespace renders like 404 —
+    job specs carry env vars and must not leak across tenants."""
+    vis = lambda ns: ns != "default"   # noqa: E731
+    body = ui.handle("GET", "/ui/jobs/default/train-1", visible=vis).body
+    assert "replicas" not in body and "not found" in body
+    body = ui.handle("GET", "/ui/experiments/default/sweep",
+                     visible=vis).body
+    assert "<svg" not in body and "not found" in body
+
+
+def test_dag_resolves_pipeline_by_metadata_not_prefix(tmp_path):
+    """Two pipelines where one name prefixes the other: the run's DAG must
+    come from its OWN pipeline (resolved via the run context), and a
+    custom run_id still resolves."""
+    from kubeflow_tpu.pipelines import dsl
+    from kubeflow_tpu.pipelines.client import PipelineClient
+    from kubeflow_tpu.pipelines.runner import LocalRunner
+
+    @dsl.component
+    def one() -> int:
+        return 1
+
+    @dsl.component
+    def two(v: int) -> int:
+        return v + 1
+
+    @dsl.pipeline(name="train")
+    def train():
+        one()
+
+    @dsl.pipeline(name="train-v2")
+    def train_v2():
+        a = one()
+        two(v=a.output)
+
+    client = PipelineClient(LocalRunner(workdir=str(tmp_path / "wd")))
+    client.upload_pipeline(train)
+    client.upload_pipeline(train_v2)
+    ui = WebUI(pipelines=client)
+    run = client.create_run("train-v2")
+    detail = get(ui, f"/ui/pipelines/runs/{run.run_id}")
+    assert "marker-end" in detail      # train-v2's one->two edge rendered
+    custom = client.create_run("train-v2", run_id="myrun")
+    detail = get(ui, "/ui/pipelines/runs/myrun")
+    assert "marker-end" in detail
