@@ -113,6 +113,7 @@ def main(argv=None) -> int:
     pipelines = PipelineClient(LocalRunner(
         workdir=os.path.join(cfg.state_dir, "pipelines"),
         metadata=store.backend))
+    resumed_pipelines = pipelines.resume_persisted()
 
     auth = None
     if args.auth_tokens:
@@ -146,6 +147,7 @@ def main(argv=None) -> int:
         webui=WebUI(jobs=controller, experiments=experiments,
                     serving=serving.controller, pipelines=pipelines,
                     notebooks=notebooks, tensorboards=tensorboards),
+        pipeline_client=pipelines,
     )
     op.webui.metrics = op.metrics
     # recurring pipeline runs fire from the serving loop (scheduled-workflow
@@ -172,6 +174,9 @@ def main(argv=None) -> int:
                     tls_cert=tls_cert, tls_key=tls_key)
     if resumed:
         print(f"kft-operator resumed experiments: {resumed}", flush=True)
+    if resumed_pipelines:
+        print(f"kft-operator resumed pipelines: {resumed_pipelines}",
+              flush=True)
     print(f"kft-operator serving on {args.bind_host}:{port}", flush=True)
 
     stop = threading.Event()

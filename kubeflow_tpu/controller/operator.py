@@ -87,6 +87,7 @@ class Operator:
         auth=None,
         dashboard=None,
         webui=None,
+        pipeline_client=None,
     ):
         self.controller = controller
         # One lock serializes every compound mutation of controller state
@@ -125,6 +126,11 @@ class Operator:
         self.webui = webui
         if webui is not None and webui._lock is None:
             webui._lock = self._lock
+        # optional pipelines.PipelineClient: the ml-pipeline API-server
+        # role (upload IR, create/list runs, recurring schedules).
+        # Pipelines are platform-scoped (not namespaced) like the
+        # reference's shared pipeline store; PipelineClient self-locks.
+        self.pipelines = pipeline_client
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -375,6 +381,17 @@ def _job_to_dict(job) -> dict:
     }
 
 
+def _run_to_dict(run) -> dict:
+    out = {
+        "run_id": run.run_id,
+        "state": run.state.value,
+        "tasks": {n: t.state.value for n, t in run.tasks.items()},
+    }
+    if getattr(run, "error", ""):
+        out["error"] = run.error
+    return out
+
+
 def _make_http_server(op: Operator, port: int,
                       host: str = "127.0.0.1"
                       ) -> ThreadingHTTPServer:
@@ -431,6 +448,13 @@ def _make_http_server(op: Operator, port: int,
 
         def _job_path(self):
             return self._resource_path("jobs")
+
+        def _pipeline_path(self):
+            # /apis/v1/pipelines[/...] — platform-scoped, not namespaced
+            parts = self.path.strip("/").split("/")
+            if parts[:3] == ["apis", "v1", "pipelines"]:
+                return parts[3:]
+            return None
 
         def _path_namespace(self):
             parts = self.path.strip("/").split("/")
@@ -506,6 +530,25 @@ def _make_http_server(op: Operator, port: int,
                 return self._send(200, json.dumps({"items": [
                     _isvc_to_dict(s) for (sns, _), s in ctl.services.items()
                     if sns == ns]}))
+            pp = self._pipeline_path()
+            if pp is not None and op.pipelines is not None:
+                if not pp:
+                    return self._send(200, json.dumps(
+                        {"items": op.pipelines.list_pipelines()}))
+                if pp[0] == "runs":
+                    if len(pp) == 2:
+                        run = op.pipelines.get_run(pp[1])
+                        if run is None:
+                            return self._send(404, '{"error": "not found"}')
+                        return self._send(200, json.dumps(_run_to_dict(run)))
+                    return self._send(200, json.dumps({"items": [
+                        _run_to_dict(r) for r in op.pipelines.list_runs()]}))
+                if pp[0] == "recurring":
+                    return self._send(200, json.dumps({"items": [
+                        {"name": rr.name, "pipeline": rr.pipeline,
+                         "interval_seconds": rr.interval_seconds,
+                         "enabled": rr.enabled, "run_ids": rr.run_ids}
+                        for rr in op.pipelines.list_recurring()]}))
             self._send(404, '{"error": "unknown path"}')
 
         def do_POST(self):
@@ -573,7 +616,57 @@ def _make_http_server(op: Operator, port: int,
                 except Exception as e:
                     return self._send(400, json.dumps({"error": str(e)}))
                 return self._send(201, json.dumps(_isvc_to_dict(isvc)))
+            pp = self._pipeline_path()
+            if pp is not None and op.pipelines is not None:
+                if not self._pipeline_write_allowed():
+                    return
+                try:
+                    if not pp:
+                        # upload a compiled IR document (YAML or JSON)
+                        import yaml as _yaml
+
+                        name = op.pipelines.upload_ir(_yaml.safe_load(body))
+                        return self._send(201, json.dumps({"name": name}))
+                    if len(pp) == 2 and pp[1] == "runs":
+                        # launch asynchronously: a pipeline can run for
+                        # minutes — the POST returns 202 + run_id and the
+                        # client polls the (store-backed) run status
+                        payload = json.loads(body or "{}")
+                        try:
+                            run_id = op.pipelines.create_run_async(
+                                pp[0], arguments=payload.get("arguments"),
+                                run_id=payload.get("run_id"))
+                        except KeyError:
+                            return self._send(
+                                404, '{"error": "unknown pipeline"}')
+                        return self._send(
+                            202, json.dumps({"run_id": run_id}))
+                    if pp == ["recurring"]:
+                        payload = json.loads(body)
+                        rr = op.pipelines.create_recurring_run(
+                            payload["name"], payload["pipeline"],
+                            float(payload["interval_seconds"]),
+                            arguments=payload.get("arguments"),
+                            max_concurrency=int(
+                                payload.get("max_concurrency", 1)))
+                        return self._send(201, json.dumps(
+                            {"name": rr.name, "enabled": rr.enabled}))
+                except Exception as e:
+                    return self._send(400, json.dumps({"error": str(e)}))
             self._send(404, '{"error": "unknown path"}')
+
+        def _pipeline_write_allowed(self) -> bool:
+            """Pipeline mutations are platform-scoped AND execute imported
+            component code in the daemon process, so with auth enabled
+            they are admin-only; sends the error itself when denied."""
+            if op.auth is None:
+                return True
+            user = op.auth.authenticate(self.headers.get("Authorization"))
+            if user in op.auth.admins:
+                return True
+            self._send(403, json.dumps(
+                {"error": "pipeline writes require an admin token"}))
+            return False
 
         def do_DELETE(self):
             if not self._authorized():
@@ -591,6 +684,16 @@ def _make_http_server(op: Operator, port: int,
             if ns and name and op.serving is not None:
                 with op._lock:
                     op.serving.controller.delete(ns, name)
+                return self._send(200, "{}")
+            pp = self._pipeline_path()
+            if (pp is not None and len(pp) == 2 and pp[0] == "recurring"
+                    and op.pipelines is not None):
+                if not self._pipeline_write_allowed():
+                    return
+                try:
+                    op.pipelines.disable_recurring_run(pp[1])
+                except KeyError:
+                    return self._send(404, '{"error": "not found"}')
                 return self._send(200, "{}")
             self._send(404, '{"error": "unknown path"}')
 

@@ -3,7 +3,7 @@
 
 from kubeflow_tpu.pipelines.client import PipelineClient, RecurringRun
 from kubeflow_tpu.pipelines.compiler import (
-    Compiler, compile_pipeline, load_ir,
+    Compiler, compile_pipeline, load_ir, pipeline_from_ir,
 )
 from kubeflow_tpu.pipelines.dsl import (
     Artifact, Condition, Dataset, ExitHandler, Input, Metrics, Model, Output,
@@ -18,5 +18,5 @@ __all__ = [
     "LocalRunner", "Metrics", "Model", "Output", "ParallelFor", "Pipeline",
     "PipelineClient", "RecurringRun", "RunResult", "Task", "TaskResult",
     "TaskState", "compile_pipeline", "component", "load_ir", "pipeline",
-    "run_status",
+    "pipeline_from_ir", "run_status",
 ]

@@ -2,19 +2,36 @@
 
 The ml-pipeline API-server surface (SURVEY.md §2.5: PipelineService /
 RunService / ExperimentService / RecurringRunService) reduced to its
-capability set: register pipelines, create/list/get runs, recurring runs on
-an interval schedule (the ScheduledWorkflow controller role).
+capability set: register pipelines (as traced Python or compiled IR
+documents), create/list/get runs, recurring runs on an interval schedule
+(the ScheduledWorkflow controller role).
+
+Durability (the reference's MySQL role): when constructed with ``store``
+(a metadata backend), IR-uploaded pipelines and recurring-run schedules
+are persisted as contexts (+ a status execution for the mutable enable /
+last-fire state), and ``resume_persisted()`` reloads them after a daemon
+restart. Run *status* is always durable — the runner writes it through
+the same store (``runner.run_status``) — so ``get_run``/``list_runs``
+fall back to the persisted record for runs started by a previous process.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import threading
 import time
 from typing import Any, Optional
 
 from kubeflow_tpu.pipelines import dsl
-from kubeflow_tpu.pipelines.runner import LocalRunner, RunResult, TaskState
+from kubeflow_tpu.pipelines.compiler import pipeline_from_ir
+from kubeflow_tpu.pipelines.runner import (
+    LocalRunner, RunResult, TaskResult, TaskState, run_status,
+)
+
+PIPELINE_IR_TYPE = "pipeline_ir"
+RECURRING_TYPE = "recurring_run"
+RECURRING_STATUS_TYPE = "recurring_run_status"
 
 
 @dataclasses.dataclass
@@ -34,11 +51,15 @@ class RecurringRun:
 class PipelineClient:
     """kfp.Client-equivalent over a LocalRunner backend."""
 
-    def __init__(self, runner: LocalRunner):
+    def __init__(self, runner: LocalRunner, store=None):
         self.runner = runner
+        # default the durability backend to the runner's lineage store so
+        # one WAL holds pipelines, schedules, and run state together
+        self.store = store if store is not None else runner.metadata
         self._pipelines: dict[str, dsl.Pipeline] = {}
         self._runs: dict[str, RunResult] = {}
         self._recurring: dict[str, RecurringRun] = {}
+        self._rr_status_ids: dict[str, int] = {}
         self._lock = threading.Lock()
 
     # ---------------- pipelines ----------------
@@ -49,6 +70,32 @@ class PipelineClient:
         with self._lock:
             self._pipelines[name] = pipe
         return name
+
+    def upload_ir(self, ir: dict, name: Optional[str] = None) -> str:
+        """Register a compiled IR document (the POST /pipelines surface).
+        Persisted: a daemon restart re-materializes it. Re-uploading under
+        the same name replaces the stored document (contexts are immutable
+        in the store, so the document lives in a mutable execution)."""
+        pipe = pipeline_from_ir(ir)
+        name = name or pipe.name
+        with self._lock:
+            self._pipelines[name] = pipe
+        if self.store is not None:
+            cid = self.store.put_context(PIPELINE_IR_TYPE, name)
+            did = self._doc_execution_id(
+                cid, "pipeline_ir_doc", f"{name}/ir")
+            self.store.update_execution(
+                did, state="ACTIVE", properties={"ir": json.dumps(ir)})
+        return name
+
+    def _doc_execution_id(self, cid: int, ex_type: str, ex_name: str) -> int:
+        """Get-or-create the mutable document execution under a context."""
+        for ex in self.store.executions_in_context(cid):
+            if ex.type == ex_type:
+                return ex.id
+        eid = self.store.put_execution(ex_type, name=ex_name, state="ACTIVE")
+        self.store.associate(cid, eid)
+        return eid
 
     def list_pipelines(self) -> list[str]:
         with self._lock:
@@ -66,16 +113,89 @@ class PipelineClient:
             self._runs[result.run_id] = result
         return result
 
+    def create_run_async(self, pipeline: str,
+                         arguments: Optional[dict[str, Any]] = None,
+                         run_id: Optional[str] = None) -> str:
+        """Launch a run in a background thread and return its id at once
+        (the POST /runs 202 contract). A launch that fails before the
+        runner can persist anything (e.g. missing required arguments)
+        still records a FAILED status — a 202'd run id must never 404
+        forever."""
+        import uuid
+
+        if pipeline not in self.list_pipelines():
+            raise KeyError(f"unknown pipeline {pipeline!r}")
+        run_id = run_id or f"{pipeline}-{uuid.uuid4().hex[:8]}"
+
+        def target():
+            try:
+                self.create_run(pipeline, arguments=arguments, run_id=run_id)
+            except BaseException as e:
+                self._record_failed_launch(run_id, pipeline, e)
+
+        threading.Thread(target=target, daemon=True,
+                         name=f"kft-pipeline-{run_id}").start()
+        return run_id
+
+    def _record_failed_launch(self, run_id: str, pipeline: str,
+                              err: BaseException) -> None:
+        if self.store is None:
+            return
+        try:
+            cid = self.store.put_context(
+                "pipeline_run", run_id, properties={"pipeline": pipeline})
+            sid = self._doc_execution_id(
+                cid, "pipeline_run_status", f"{run_id}/status")
+            self.store.update_execution(
+                sid, state="FAILED",
+                properties={"pipeline": pipeline, "tasks": {},
+                            "error": f"{type(err).__name__}: {err}"})
+        except Exception:
+            pass   # persistence is best-effort here; the thread must not die
+
     def get_run(self, run_id: str) -> Optional[RunResult]:
         with self._lock:
-            return self._runs.get(run_id)
+            run = self._runs.get(run_id)
+        if run is not None:
+            return run
+        return self._run_from_store(run_id)
+
+    def _run_from_store(self, run_id: str) -> Optional[RunResult]:
+        """Reconstruct a RunResult from the persisted status record (runs
+        started by a previous process, or in flight in another thread)."""
+        if self.store is None:
+            return None
+        st = run_status(self.store, run_id)
+        if st is None:
+            return None
+        state_map = {"RUNNING": TaskState.RUNNING,
+                     "SUCCEEDED": TaskState.SUCCEEDED,
+                     "FAILED": TaskState.FAILED}
+        return RunResult(
+            run_id=run_id,
+            state=state_map.get(st["state"], TaskState.PENDING),
+            tasks={n: TaskResult(name=n, state=TaskState(s))
+                   for n, s in (st.get("tasks") or {}).items()},
+            params={},
+            error=st.get("error", ""),
+        )
 
     def list_runs(self, pipeline: Optional[str] = None) -> list[RunResult]:
         with self._lock:
-            runs = list(self._runs.values())
+            runs = dict(self._runs)
+        # merge persisted runs from earlier processes (in-proc store only:
+        # it exposes the context table; remote stores list via run ids)
+        contexts = getattr(self.store, "contexts", None)
+        if contexts is not None:
+            for c in list(contexts.values()):
+                if c.type == "pipeline_run" and c.name not in runs:
+                    rec = self._run_from_store(c.name)
+                    if rec is not None:
+                        runs[c.name] = rec
+        out = list(runs.values())
         if pipeline:
-            runs = [r for r in runs if r.run_id.startswith(pipeline)]
-        return sorted(runs, key=lambda r: r.run_id)
+            out = [r for r in out if r.run_id.startswith(pipeline)]
+        return sorted(out, key=lambda r: r.run_id)
 
     # ---------------- recurring runs ----------------
 
@@ -91,11 +211,97 @@ class PipelineClient:
                           max_concurrency=max_concurrency)
         with self._lock:
             self._recurring[name] = rr
+        self._persist_recurring(rr)
         return rr
 
     def disable_recurring_run(self, name: str) -> None:
         with self._lock:
-            self._recurring[name].enabled = False
+            rr = self._recurring[name]
+            rr.enabled = False
+        self._sync_recurring_status(rr)
+
+    def list_recurring(self) -> list[RecurringRun]:
+        """Snapshot of the recurring schedules (safe to iterate while
+        other requests mutate the registry)."""
+        with self._lock:
+            return [dataclasses.replace(rr, run_ids=list(rr.run_ids))
+                    for rr in self._recurring.values()]
+
+    def _persist_recurring(self, rr: RecurringRun) -> None:
+        """The WHOLE recurring record (spec + mutable state) lives in the
+        status execution so re-creating a schedule replaces it."""
+        if self.store is None:
+            return
+        cid = self.store.put_context(RECURRING_TYPE, rr.name)
+        self._rr_status_id(rr.name, cid)
+        self._sync_recurring_status(rr)
+
+    def _rr_status_id(self, name: str, cid: int) -> Optional[int]:
+        if self.store is None:
+            return None
+        if name not in self._rr_status_ids:
+            self._rr_status_ids[name] = self._doc_execution_id(
+                cid, RECURRING_STATUS_TYPE, f"{name}/status")
+        return self._rr_status_ids[name]
+
+    def _sync_recurring_status(self, rr: RecurringRun) -> None:
+        if self.store is None or rr.name not in self._rr_status_ids:
+            return
+        self.store.update_execution(
+            self._rr_status_ids[rr.name],
+            state="ENABLED" if rr.enabled else "DISABLED",
+            properties={"spec": json.dumps({
+                "pipeline": rr.pipeline,
+                "interval_seconds": rr.interval_seconds,
+                "arguments": rr.arguments,
+                "max_concurrency": rr.max_concurrency,
+            }), "last_fire": rr.last_fire, "run_ids": list(rr.run_ids)})
+
+    # ---------------- restart resume (persistence-agent role) -----------
+
+    def resume_persisted(self) -> list[str]:
+        """Reload IR pipelines + recurring schedules persisted by an
+        earlier process. Returns the resumed pipeline names. Requires an
+        in-proc store (context table access)."""
+        contexts = getattr(self.store, "contexts", None)
+        if contexts is None:
+            return []
+        resumed = []
+        for c in list(contexts.values()):
+            if c.type != PIPELINE_IR_TYPE:
+                continue
+            try:
+                did = self._doc_execution_id(
+                    c.id, "pipeline_ir_doc", f"{c.name}/ir")
+                ir = json.loads(self.store.get_execution(did)
+                                .properties["ir"])
+                pipe = pipeline_from_ir(ir)
+            except Exception:
+                continue   # component module gone — skip, don't wedge boot
+            with self._lock:
+                self._pipelines.setdefault(c.name, pipe)
+            resumed.append(c.name)
+        for c in list(contexts.values()):
+            if c.type != RECURRING_TYPE:
+                continue
+            sid = self._rr_status_id(c.name, c.id)
+            ex = self.store.get_execution(sid)
+            if "spec" not in ex.properties:
+                continue
+            spec = json.loads(ex.properties["spec"])
+            if spec["pipeline"] not in self._pipelines:
+                continue
+            rr = RecurringRun(
+                name=c.name, pipeline=spec["pipeline"],
+                interval_seconds=spec["interval_seconds"],
+                arguments=dict(spec.get("arguments", {})),
+                max_concurrency=spec.get("max_concurrency", 1),
+                enabled=ex.state != "DISABLED",
+                last_fire=float(ex.properties.get("last_fire", 0.0)),
+                run_ids=list(ex.properties.get("run_ids", [])))
+            with self._lock:
+                self._recurring.setdefault(c.name, rr)
+        return resumed
 
     def tick(self, now: Optional[float] = None) -> list[RunResult]:
         """Fire due recurring runs (the scheduled-workflow controller's
@@ -128,4 +334,5 @@ class PipelineClient:
                 rr._inflight -= 1
                 rr.run_ids.append(result.run_id)
             fired.append(result)
+            self._sync_recurring_status(rr)
         return fired

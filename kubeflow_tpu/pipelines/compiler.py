@@ -112,3 +112,113 @@ def load_ir(path: str) -> dict:
         raise ValueError(
             f"unsupported IR schema {ir.get('schemaVersion')!r}")
     return ir
+
+
+# ---------------------------------------------------------------------------
+# IR -> executable pipeline (the API-server side of the compiler: a client
+# compiles locally and POSTS the IR; the server re-materializes the graph
+# and runs it. Reference analogue: apiserver expanding PipelineSpec proto
+# into an Argo Workflow, SURVEY.md §3.4 — here the IR becomes a Pipeline
+# whose trace() rebuilds the task graph directly, no user fn re-executed.)
+# ---------------------------------------------------------------------------
+
+def _decode_value(d: dict) -> Any:
+    if "taskOutput" in d:
+        return dsl.OutputRef(d["taskOutput"]["task"], d["taskOutput"]["output"])
+    if "pipelineParameter" in d:
+        return dsl.ParamRef(d["pipelineParameter"])
+    if "loopItem" in d:
+        return dsl.LoopItemRef(d["loopItem"], d.get("field"))
+    return d["constant"]
+
+
+def _resolve_fn(fn_ref: str):
+    """'module:qualname' -> the raw component function. The module-level
+    name is rebound to the Component wrapper by the decorator; resolution
+    REQUIRES that wrapper: an IR may only reference functions their owner
+    explicitly registered as components. Resolving arbitrary callables
+    (e.g. ``os:system``) would turn the IR-upload API into remote code
+    execution with attacker-chosen arguments. '<locals>' qualnames
+    (components defined inside functions) are not importable by design."""
+    import importlib
+
+    mod_name, _, qual = fn_ref.partition(":")
+    if "<locals>" in qual:
+        raise ValueError(
+            f"component fn {fn_ref!r} is not importable (defined inside a "
+            "function); IR-submitted pipelines need module-level components")
+    obj = importlib.import_module(mod_name)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    if not isinstance(obj, dsl.Component):
+        raise ValueError(
+            f"{fn_ref!r} is not a registered @dsl.component; IR pipelines "
+            "may only call functions their module exposes as components")
+    return obj.spec.fn
+
+
+class _IRPipeline(dsl.Pipeline):
+    """A Pipeline whose trace() replays the IR's DAG instead of calling a
+    pipeline function."""
+
+    def __init__(self, ir: dict):
+        self._ir = ir
+        params = {
+            k: (v["defaultValue"] if "defaultValue" in v else dsl.REQUIRED)
+            for k, v in ir["root"]["inputDefinitions"]["parameters"].items()
+        }
+        self._components: dict[str, dsl.Component] = {}
+        for key, c in ir["components"].items():
+            spec = dsl.ComponentSpec(
+                name=c["name"], fn=_resolve_fn(c["fnRef"]),
+                inputs=dict(c["inputs"]),
+                output_artifacts=dict(c["outputArtifacts"]),
+                return_output=c["returnOutput"], defaults={},
+                retries=c.get("retries", 0),
+                cache_enabled=c.get("cacheEnabled", True))
+            self._components[key] = dsl.Component(spec)
+        super().__init__(dsl.PipelineSpec(
+            name=ir["pipelineInfo"]["name"], fn=self._no_fn, params=params))
+
+    @staticmethod
+    def _no_fn(**kwargs):
+        raise RuntimeError("IR pipelines trace from the document")
+
+    def trace(self, arguments: Optional[dict] = None) -> dsl._PipelineContext:
+        args = dict(self.spec.params)
+        args.update(arguments or {})
+        ctx = dsl._PipelineContext(self.name, args)
+        loops: dict[str, dsl.ParallelFor] = {}
+
+        def loop_for(lid: str, items: Any) -> dsl.ParallelFor:
+            if lid not in loops:
+                lp = dsl.ParallelFor.__new__(dsl.ParallelFor)
+                lp.loop_id = lid
+                lp.items = items
+                loops[lid] = lp
+            return loops[lid]
+
+        for tname, t in self._ir["root"]["dag"]["tasks"].items():
+            ctx.tasks[tname] = dsl.Task(
+                name=tname,
+                component=self._components[t["componentRef"]],
+                arguments={k: _decode_value(v)
+                           for k, v in t.get("inputs", {}).items()},
+                dependencies=list(t.get("dependentTasks", [])),
+                conditions=[
+                    dsl.ConditionExpr(_decode_value(c["lhs"]), c["op"],
+                                      _decode_value(c["rhs"]))
+                    for c in t.get("triggerConditions", [])],
+                loops=[loop_for(it["loopId"], _decode_value(it["items"]))
+                       for it in t.get("iterators", [])],
+                is_exit_handler=t.get("exitHandler", False),
+            )
+        return ctx
+
+
+def pipeline_from_ir(ir: dict) -> dsl.Pipeline:
+    """Re-materialize an executable Pipeline from a compiled IR document."""
+    if ir.get("schemaVersion") != IR_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported IR schema {ir.get('schemaVersion')!r}")
+    return _IRPipeline(ir)

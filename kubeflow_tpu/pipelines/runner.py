@@ -63,6 +63,8 @@ class RunResult:
     tasks: dict[str, TaskResult]
     params: dict[str, Any]
     context_id: Optional[int] = None
+    error: str = ""               # launch-level failure (task errors live
+                                  # on the TaskResults)
 
     def task(self, name: str) -> TaskResult:
         return self.tasks[name]
@@ -520,6 +522,7 @@ def run_status(metadata, run_id: str) -> Optional[dict]:
                 "pipeline": ex.properties.get("pipeline", ""),
                 "state": ex.state,
                 "tasks": ex.properties.get("tasks", {}),
+                "error": ex.properties.get("error", ""),
             }
     return None
 

@@ -571,7 +571,7 @@ class WebUI:
                 for ref in _refs(v, dsl.OutputRef):
                     base_edges.add((ref.task, t.name))
             for cond in t.conditions:
-                for ref in _refs((cond.left, cond.right), dsl.OutputRef):
+                for ref in _refs((cond.lhs, cond.rhs), dsl.OutputRef):
                     base_edges.add((ref.task, t.name))
 
         def instances(base: str) -> list[str]:
