@@ -18,6 +18,7 @@ fall back to the persisted record for runs started by a previous process.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import threading
 import time
@@ -60,6 +61,7 @@ class PipelineClient:
         self._runs: dict[str, RunResult] = {}
         self._recurring: dict[str, RecurringRun] = {}
         self._rr_status_ids: dict[str, int] = {}
+        self._fire_seq = itertools.count()
         self._lock = threading.Lock()
 
     # ---------------- pipelines ----------------
@@ -79,13 +81,15 @@ class PipelineClient:
         pipe = pipeline_from_ir(ir)
         name = name or pipe.name
         with self._lock:
+            # store writes stay under the lock: concurrent uploads of the
+            # same name must not each get-or-create a doc execution
             self._pipelines[name] = pipe
-        if self.store is not None:
-            cid = self.store.put_context(PIPELINE_IR_TYPE, name)
-            did = self._doc_execution_id(
-                cid, "pipeline_ir_doc", f"{name}/ir")
-            self.store.update_execution(
-                did, state="ACTIVE", properties={"ir": json.dumps(ir)})
+            if self.store is not None:
+                cid = self.store.put_context(PIPELINE_IR_TYPE, name)
+                did = self._doc_execution_id(
+                    cid, "pipeline_ir_doc", f"{name}/ir")
+                self.store.update_execution(
+                    did, state="ACTIVE", properties={"ir": json.dumps(ir)})
         return name
 
     def _doc_execution_id(self, cid: int, ex_type: str, ex_name: str) -> int:
@@ -126,6 +130,11 @@ class PipelineClient:
         if pipeline not in self.list_pipelines():
             raise KeyError(f"unknown pipeline {pipeline!r}")
         run_id = run_id or f"{pipeline}-{uuid.uuid4().hex[:8]}"
+        # reject path-traversing ids HERE (synchronous 400), not in the
+        # background thread where the error would only reach the store
+        if "/" in run_id or "\\" in run_id or ".." in run_id \
+                or not run_id.strip():
+            raise ValueError(f"invalid run_id {run_id!r}")
 
         def target():
             try:
@@ -166,6 +175,11 @@ class PipelineClient:
         if self.store is None:
             return None
         st = run_status(self.store, run_id)
+        return self._run_from_status(run_id, st)
+
+    @staticmethod
+    def _run_from_status(run_id: str, st: Optional[dict]
+                         ) -> Optional[RunResult]:
         if st is None:
             return None
         state_map = {"RUNNING": TaskState.RUNNING,
@@ -184,14 +198,25 @@ class PipelineClient:
         with self._lock:
             runs = dict(self._runs)
         # merge persisted runs from earlier processes (in-proc store only:
-        # it exposes the context table; remote stores list via run ids)
+        # it exposes the context table; remote stores list via run ids).
+        # Status is read straight off each context's executions — going
+        # through run_status would re-resolve every context by name and
+        # make this quadratic in run history.
         contexts = getattr(self.store, "contexts", None)
         if contexts is not None:
             for c in list(contexts.values()):
-                if c.type == "pipeline_run" and c.name not in runs:
-                    rec = self._run_from_store(c.name)
-                    if rec is not None:
-                        runs[c.name] = rec
+                if c.type != "pipeline_run" or c.name in runs:
+                    continue
+                for ex in self.store.executions_in_context(c.id):
+                    if ex.type == "pipeline_run_status":
+                        rec = self._run_from_status(c.name, {
+                            "state": ex.state,
+                            "tasks": ex.properties.get("tasks", {}),
+                            "error": ex.properties.get("error", ""),
+                        })
+                        if rec is not None:
+                            runs[c.name] = rec
+                        break
         out = list(runs.values())
         if pipeline:
             out = [r for r in out if r.run_id.startswith(pipeline)]
@@ -210,8 +235,10 @@ class PipelineClient:
                           arguments=dict(arguments or {}),
                           max_concurrency=max_concurrency)
         with self._lock:
+            # registry + store writes together: concurrent creates of the
+            # same schedule must not duplicate the status execution
             self._recurring[name] = rr
-        self._persist_recurring(rr)
+            self._persist_recurring(rr)
         return rr
 
     def disable_recurring_run(self, name: str) -> None:
@@ -322,9 +349,13 @@ class PipelineClient:
         for rr in due:
             # one failing schedule must not starve the others this tick
             try:
+                # ms precision + a process-wide sequence: sub-second
+                # intervals must never reuse a run_id (the store keys run
+                # state by it; a duplicate would shadow the second run)
                 result = self.create_run(
                     rr.pipeline, arguments=rr.arguments,
-                    run_id=f"{rr.pipeline}-{rr.name}-{int(now)}")
+                    run_id=f"{rr.pipeline}-{rr.name}-{int(now * 1000)}"
+                           f".{next(self._fire_seq)}")
             except Exception as e:
                 with self._lock:
                     rr._inflight -= 1

@@ -59,6 +59,10 @@ def compile_pipeline(pipe: dsl.Pipeline) -> dict:
                 "cacheEnabled": spec.cache_enabled,
                 "fnRef": f"{spec.fn.__module__}:{spec.fn.__qualname__}",
             }
+            if spec.defaults:
+                # call sites may omit defaulted params; the runner falls
+                # back to these at execution time
+                components[comp_key]["defaults"] = dict(spec.defaults)
         t: dict[str, Any] = {
             "componentRef": comp_key,
             "inputs": {k: _encode_value(v)
@@ -173,7 +177,8 @@ class _IRPipeline(dsl.Pipeline):
                 name=c["name"], fn=_resolve_fn(c["fnRef"]),
                 inputs=dict(c["inputs"]),
                 output_artifacts=dict(c["outputArtifacts"]),
-                return_output=c["returnOutput"], defaults={},
+                return_output=c["returnOutput"],
+                defaults=dict(c.get("defaults", {})),
                 retries=c.get("retries", 0),
                 cache_enabled=c.get("cacheEnabled", True))
             self._components[key] = dsl.Component(spec)

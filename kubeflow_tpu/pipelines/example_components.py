@@ -13,7 +13,7 @@ from kubeflow_tpu.pipelines import dsl
 
 
 @dsl.component
-def score_shard(shard: int, scale: float) -> float:
+def score_shard(shard: int, scale: float = 1.0) -> float:
     return shard * scale
 
 

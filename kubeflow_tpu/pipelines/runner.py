@@ -116,6 +116,11 @@ class LocalRunner:
 
         ctx = pipe.trace()
         run_id = run_id or f"{pipe.name}-{uuid.uuid4().hex[:8]}"
+        # run_id becomes a directory name under workdir; client-supplied
+        # ids (HTTP run_id field) must not traverse out of it
+        if ("/" in run_id or "\\" in run_id or ".." in run_id
+                or not run_id.strip()):
+            raise ValueError(f"invalid run_id {run_id!r}")
         run_dir = os.path.join(self.workdir, run_id)
         os.makedirs(run_dir, exist_ok=True)
         context_id = self.metadata.put_context(

@@ -172,6 +172,14 @@ class LLMEngine:
         self._prefill = jax.jit(
             lambda p, toks, lens, cache: llama.prefill(
                 p, toks, cfg, cache, lengths=lens))
+        # first-token sampling + its logprob in ONE jitted call: computing
+        # log_softmax eagerly per admitted request costs an op-by-op
+        # full-vocab dispatch + transfer (catastrophic on a remote chip)
+        self._first_sample = jax.jit(
+            lambda logits, rng, t, k, p: (
+                (tok := sample_logits(logits, rng, t, k, p)),
+                jnp.take_along_axis(logits, tok[:, None], axis=-1)[:, 0]
+                - jax.nn.logsumexp(logits, axis=-1)))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._set_len = jax.jit(
@@ -191,10 +199,11 @@ class LLMEngine:
                 params, token, self.cfg, cache, tables)
             nxt = sample_logits(logits, rng_step, temperature, top_k, top_p)
             # chosen-token logprob under the MODEL distribution (OpenAI
-            # convention: pre-temperature/filtering)
+            # convention: pre-temperature/filtering). Gather-then-logsumexp
+            # rather than materializing the full [B, V] log_softmax.
             lp = jnp.take_along_axis(
-                jax.nn.log_softmax(logits, axis=-1),
-                nxt[:, None], axis=-1)[:, 0]
+                logits, nxt[:, None], axis=-1)[:, 0] \
+                - jax.nn.logsumexp(logits, axis=-1)
             # idle slots: pin len to 0 so the cursor can't creep toward
             # max_seq (their scatter lands in the scratch block 0)
             cache["len"] = jnp.where(active, cache["len"], 0)
@@ -370,14 +379,13 @@ class LLMEngine:
                 self.params, jnp.asarray(toks),
                 jnp.asarray([len(req.prompt)], jnp.int32), scratch)
             self._rng, rng = jax.random.split(self._rng)
-            first = sample_logits(
+            first, first_lp_arr = self._first_sample(
                 logits, rng,
                 jnp.asarray([req.sampling.temperature], jnp.float32),
                 jnp.asarray([req.sampling.top_k], jnp.int32),
                 jnp.asarray([req.sampling.top_p], jnp.float32))
             first_tok = int(np.asarray(first)[0])
-            first_lp = float(np.asarray(jax.nn.log_softmax(
-                logits[0]))[first_tok])
+            first_lp = float(np.asarray(first_lp_arr)[0])
             # write only the blocks covering the true prompt length (pad
             # rows past them are never attended), and within those skip the
             # shared prefix blocks — their identical KV is already resident

@@ -17,6 +17,8 @@ import pytest
 import yaml
 
 from kubeflow_tpu.metadata.store import MetadataStore
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 from kubeflow_tpu.pipelines import (
     PipelineClient, LocalRunner, TaskState, compile_pipeline,
     pipeline_from_ir,
@@ -106,7 +108,7 @@ def _start_daemon(tmp_path):
          "--state-dir", str(tmp_path / "state"),
          "--log-dir", str(tmp_path / "pods")],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env={**os.environ, "PYTHONPATH": "/root/repo"})
+        env={**os.environ, "PYTHONPATH": REPO_ROOT})
     port = None
     deadline = time.time() + 60
     while time.time() < deadline:
@@ -252,12 +254,17 @@ def test_daemon_pipeline_writes_require_admin(tmp_path):
          "--log-dir", str(tmp_path / "pods"),
          "--auth-tokens", str(auth_file)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env={**os.environ, "PYTHONPATH": "/root/repo"})
+        env={**os.environ, "PYTHONPATH": REPO_ROOT})
     port = None
-    while port is None:
-        m = re.search(r"serving on [\w.]+:(\d+)", proc.stdout.readline())
+    deadline = time.time() + 60
+    while port is None and time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break   # EOF: daemon died at startup
+        m = re.search(r"serving on [\w.]+:(\d+)", line)
         if m:
             port = int(m.group(1))
+    assert port, "daemon never bound"
     base = f"http://127.0.0.1:{port}"
     ir = _yaml.safe_dump(compile_pipeline(shard_scores)).encode()
     try:
@@ -274,3 +281,40 @@ def test_daemon_pipeline_writes_require_admin(tmp_path):
     finally:
         proc.send_signal(signal.SIGTERM)
         proc.wait(timeout=15)
+
+
+def test_ir_roundtrip_preserves_component_defaults(tmp_path):
+    from kubeflow_tpu.pipelines import dsl
+    from kubeflow_tpu.pipelines import example_components as ec
+
+    # score_shard's sibling with a defaulted param, module-level not
+    # required here: defaults must survive compile -> IR -> rebuild, so
+    # use the shipped components but call with an omitted default via a
+    # synthetic component spec check instead
+    ir = compile_pipeline(shard_scores)
+    pipe = pipeline_from_ir(ir)
+    for key, comp in pipe._components.items():
+        src = ir["components"][key]
+        assert comp.spec.defaults == src.get("defaults", {})
+
+
+def test_run_id_path_traversal_rejected(tmp_path):
+    c = _client(tmp_path, "w1")
+    c.upload_ir(compile_pipeline(shard_scores))
+    for bad in ("../../tmp/evil", "a/b", "..", " "):
+        with pytest.raises(ValueError, match="invalid run_id"):
+            c.create_run_async("shard-scores", run_id=bad)
+        with pytest.raises(ValueError, match="invalid run_id"):
+            c.runner.run(c._pipelines["shard-scores"], run_id=bad)
+    assert not (tmp_path / "tmp").exists()
+
+
+def test_subsecond_recurring_runs_get_unique_ids(tmp_path):
+    c = _client(tmp_path, "w1")
+    c.upload_ir(compile_pipeline(shard_scores))
+    c.create_recurring_run("fast", "shard-scores", interval_seconds=0)
+    ids = []
+    for _ in range(3):
+        fired = c.tick(now=1e9)       # same wall-clock instant every time
+        ids += [r.run_id for r in fired]
+    assert len(ids) == 3 and len(set(ids)) == 3
