@@ -28,6 +28,7 @@ from kubeflow_tpu.pipelines import dsl
 from kubeflow_tpu.pipelines.compiler import pipeline_from_ir
 from kubeflow_tpu.pipelines.runner import (
     LocalRunner, RunResult, TaskResult, TaskState, run_status,
+    validate_run_id,
 )
 
 PIPELINE_IR_TYPE = "pipeline_ir"
@@ -130,11 +131,9 @@ class PipelineClient:
         if pipeline not in self.list_pipelines():
             raise KeyError(f"unknown pipeline {pipeline!r}")
         run_id = run_id or f"{pipeline}-{uuid.uuid4().hex[:8]}"
-        # reject path-traversing ids HERE (synchronous 400), not in the
-        # background thread where the error would only reach the store
-        if "/" in run_id or "\\" in run_id or ".." in run_id \
-                or not run_id.strip():
-            raise ValueError(f"invalid run_id {run_id!r}")
+        # reject bad ids HERE (synchronous 400), not in the background
+        # thread where the error would only reach the store
+        validate_run_id(run_id)
 
         def target():
             try:

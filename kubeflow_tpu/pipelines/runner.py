@@ -24,6 +24,7 @@ import inspect
 import itertools
 import json
 import os
+import re
 import shutil
 import threading
 import time
@@ -90,6 +91,18 @@ class _Instance:
     deps: set[str] = dataclasses.field(default_factory=set)
 
 
+_RUN_ID_RE = re.compile(r"[A-Za-z0-9][\w.\-]*", re.ASCII)
+
+
+def validate_run_id(run_id: str) -> None:
+    """run_id becomes a directory name under the runner workdir, so
+    client-supplied ids (the HTTP run_id field) must not traverse out of
+    it, collapse onto it ("."), or collide with reserved entries like
+    the leading-underscore cache dir."""
+    if not _RUN_ID_RE.fullmatch(run_id):
+        raise ValueError(f"invalid run_id {run_id!r}")
+
+
 class LocalRunner:
     """Executes a traced pipeline graph. ``workdir`` holds artifacts and the
     execution cache; ``metadata`` records lineage."""
@@ -116,11 +129,7 @@ class LocalRunner:
 
         ctx = pipe.trace()
         run_id = run_id or f"{pipe.name}-{uuid.uuid4().hex[:8]}"
-        # run_id becomes a directory name under workdir; client-supplied
-        # ids (HTTP run_id field) must not traverse out of it
-        if ("/" in run_id or "\\" in run_id or ".." in run_id
-                or not run_id.strip()):
-            raise ValueError(f"invalid run_id {run_id!r}")
+        validate_run_id(run_id)
         run_dir = os.path.join(self.workdir, run_id)
         os.makedirs(run_dir, exist_ok=True)
         context_id = self.metadata.put_context(

@@ -448,7 +448,7 @@ class WebUI:
             f"</td><td>{rr.interval_seconds:g}s</td>"
             f"<td>{'yes' if rr.enabled else 'no'}</td>"
             f"<td>{len(rr.run_ids)}</td></tr>"
-            for rr in getattr(self.pipelines, "_recurring", {}).values())
+            for rr in self.pipelines.list_recurring())
         return (
             f"<h2>Pipelines</h2><ul>{pipes or '<li>none uploaded</li>'}</ul>"
             "<h2>Runs</h2><table><tr><th>Run</th><th>State</th>"
@@ -468,9 +468,12 @@ class WebUI:
             f"<td><code>{_E(json.dumps(t.outputs, default=str)[:200])}</code>"
             f"</td><td>{_E(t.error[:200])}</td></tr>"
             for t in run.tasks.values())
+        err = getattr(run, "error", "")
         return (
             f"<p>state {_pill(run.state)} · params "
             f"<code>{_E(json.dumps(run.params, default=str))}</code></p>"
+            + (f'<p class="bad">launch error: <code>{_E(err)}</code></p>'
+               if err else "")
             + self._dag_svg(run)
             + "<h2>Tasks</h2><table><tr><th>Task</th><th>State</th>"
             f"<th>Attempts</th><th>Outputs</th><th>Error</th></tr>{rows}"

@@ -17,13 +17,13 @@ import pytest
 import yaml
 
 from kubeflow_tpu.metadata.store import MetadataStore
-
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 from kubeflow_tpu.pipelines import (
     PipelineClient, LocalRunner, TaskState, compile_pipeline,
     pipeline_from_ir,
 )
 from kubeflow_tpu.pipelines.example_components import shard_scores
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_ir_roundtrip_executes_identically(tmp_path):
@@ -283,14 +283,10 @@ def test_daemon_pipeline_writes_require_admin(tmp_path):
         proc.wait(timeout=15)
 
 
-def test_ir_roundtrip_preserves_component_defaults(tmp_path):
-    from kubeflow_tpu.pipelines import dsl
-    from kubeflow_tpu.pipelines import example_components as ec
-
-    # score_shard's sibling with a defaulted param, module-level not
-    # required here: defaults must survive compile -> IR -> rebuild, so
-    # use the shipped components but call with an omitted default via a
-    # synthetic component spec check instead
+def test_ir_roundtrip_preserves_component_defaults():
+    """Component parameter defaults (score_shard's scale=1.0) must survive
+    compile -> IR -> rebuild — the runner falls back to them when a call
+    site omits the argument."""
     ir = compile_pipeline(shard_scores)
     pipe = pipeline_from_ir(ir)
     for key, comp in pipe._components.items():
@@ -301,12 +297,17 @@ def test_ir_roundtrip_preserves_component_defaults(tmp_path):
 def test_run_id_path_traversal_rejected(tmp_path):
     c = _client(tmp_path, "w1")
     c.upload_ir(compile_pipeline(shard_scores))
-    for bad in ("../../tmp/evil", "a/b", "..", " "):
+    for bad in ("../../tmp/evil", "a/b", "..", " ", ".", "_cache",
+                "a\\b"):
         with pytest.raises(ValueError, match="invalid run_id"):
             c.create_run_async("shard-scores", run_id=bad)
         with pytest.raises(ValueError, match="invalid run_id"):
             c.runner.run(c._pipelines["shard-scores"], run_id=bad)
-    assert not (tmp_path / "tmp").exists()
+    # nothing escaped the workdir, collapsed onto it, or hit the cache dir
+    assert not (tmp_path.parent / "tmp").exists()
+    import os as _os
+
+    assert set(_os.listdir(tmp_path / "w1")) <= {"_cache"}
 
 
 def test_subsecond_recurring_runs_get_unique_ids(tmp_path):
