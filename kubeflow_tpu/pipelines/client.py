@@ -28,7 +28,7 @@ from kubeflow_tpu.pipelines import dsl
 from kubeflow_tpu.pipelines.compiler import pipeline_from_ir
 from kubeflow_tpu.pipelines.runner import (
     LocalRunner, RunResult, TaskResult, TaskState, run_status,
-    validate_run_id,
+    sanitize_run_component, validate_run_id,
 )
 
 PIPELINE_IR_TYPE = "pipeline_ir"
@@ -130,10 +130,14 @@ class PipelineClient:
 
         if pipeline not in self.list_pipelines():
             raise KeyError(f"unknown pipeline {pipeline!r}")
-        run_id = run_id or f"{pipeline}-{uuid.uuid4().hex[:8]}"
-        # reject bad ids HERE (synchronous 400), not in the background
-        # thread where the error would only reach the store
-        validate_run_id(run_id)
+        if run_id is None:
+            run_id = (f"{sanitize_run_component(pipeline)}-"
+                      f"{uuid.uuid4().hex[:8]}")
+        else:
+            # reject bad CLIENT-supplied ids HERE (synchronous 400), not
+            # in the background thread where the error only reaches the
+            # store; auto-generated ids sanitize the name instead
+            validate_run_id(run_id)
 
         def target():
             try:
@@ -353,8 +357,9 @@ class PipelineClient:
                 # state by it; a duplicate would shadow the second run)
                 result = self.create_run(
                     rr.pipeline, arguments=rr.arguments,
-                    run_id=f"{rr.pipeline}-{rr.name}-{int(now * 1000)}"
-                           f".{next(self._fire_seq)}")
+                    run_id=f"{sanitize_run_component(rr.pipeline)}-"
+                           f"{sanitize_run_component(rr.name)}-"
+                           f"{int(now * 1000)}.{next(self._fire_seq)}")
             except Exception as e:
                 with self._lock:
                     rr._inflight -= 1

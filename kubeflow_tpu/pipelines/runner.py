@@ -103,6 +103,16 @@ def validate_run_id(run_id: str) -> None:
         raise ValueError(f"invalid run_id {run_id!r}")
 
 
+def sanitize_run_component(name: str) -> str:
+    """Make an arbitrary pipeline/schedule name safe inside an
+    auto-generated run_id (strict validation applies only to ids a
+    CLIENT supplies; legal-but-odd pipeline names must keep working)."""
+    out = re.sub(r"[^\w.\-]", "-", name, flags=re.ASCII)
+    if not out or not out[0].isalnum():
+        out = "p" + out
+    return out
+
+
 class LocalRunner:
     """Executes a traced pipeline graph. ``workdir`` holds artifacts and the
     execution cache; ``metadata`` records lineage."""
@@ -128,8 +138,11 @@ class LocalRunner:
             raise ValueError(f"missing pipeline arguments: {missing}")
 
         ctx = pipe.trace()
-        run_id = run_id or f"{pipe.name}-{uuid.uuid4().hex[:8]}"
-        validate_run_id(run_id)
+        if run_id is None:
+            run_id = (f"{sanitize_run_component(pipe.name)}-"
+                      f"{uuid.uuid4().hex[:8]}")
+        else:
+            validate_run_id(run_id)
         run_dir = os.path.join(self.workdir, run_id)
         os.makedirs(run_dir, exist_ok=True)
         context_id = self.metadata.put_context(

@@ -173,10 +173,12 @@ class LLMModel(Model):
                  max_seq: int = 1024, pad_id: int = 0,
                  compile_cache_dir: Optional[str] = None,
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
-                 tokenizer=None, request_timeout: float = 600.0):
+                 tokenizer=None, request_timeout: float = 600.0,
+                 mesh=None):
         super().__init__(name)
         self._params = params
         self.cfg = cfg
+        self.mesh = mesh
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.pad_id = pad_id
@@ -205,7 +207,7 @@ class LLMModel(Model):
             model_dir, dtype=dtype or jnp.bfloat16, mesh=mesh)
         tok = load_tokenizer(model_dir)
         kw.setdefault("max_seq", min(cfg.max_seq, 1024))
-        return cls(name, params, cfg, tokenizer=tok, **kw)
+        return cls(name, params, cfg, tokenizer=tok, mesh=mesh, **kw)
 
     def load(self) -> bool:
         if self.compile_cache_dir:
@@ -214,7 +216,8 @@ class LLMModel(Model):
             self._params, self.cfg, max_batch=self.max_batch,
             max_seq=self.max_seq,
             prefill_buckets=[b for b in self.prefill_buckets
-                             if b <= self.max_seq] or [self.max_seq])
+                             if b <= self.max_seq] or [self.max_seq],
+            mesh=self.mesh)
         self._shutdown = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()

@@ -118,11 +118,13 @@ class LLMEngine:
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
                  kv_block_size: Optional[int] = None,
                  kv_num_blocks: Optional[int] = None,
-                 decode_chunk: int = 8):
+                 decode_chunk: int = 8,
+                 mesh=None):
         from kubeflow_tpu.serving.paged_kv import PagedKV
 
         self.params = params
         self.cfg = cfg
+        self.mesh = mesh
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.buckets = sorted(b for b in prefill_buckets if b <= max_seq)
@@ -148,9 +150,29 @@ class LLMEngine:
                     f"every prefill bucket (got {b})")
         if kv_num_blocks is None:
             kv_num_blocks = max_batch * (max_seq // kv_block_size) + 1
+        kv_sh = len_sh = None
+        if mesh is not None:
+            # tensor-parallel serving: the KV pool shards over the mesh's
+            # `tensor` axis on the kv-head dim (matching the TP-sharded
+            # params the loader placed); everything else is replicated and
+            # jit auto-partitions the prefill/decode programs (SPMD — XLA
+            # inserts the collectives). Host-side tables stay numpy. The
+            # pool allocates directly with this sharding — a pod-sized
+            # pool must never transit one chip unsharded.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            tp = mesh.shape.get("tensor", 1)
+            if cfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"n_kv_heads={cfg.n_kv_heads} not divisible by "
+                    f"tensor={tp}")
+            kv_sh = NamedSharding(
+                mesh, PartitionSpec(None, None, None, "tensor", None))
+            len_sh = NamedSharding(mesh, PartitionSpec())
         self.paged = PagedKV(cfg=cfg, max_batch=max_batch, max_seq=max_seq,
                              block_size=kv_block_size,
-                             num_blocks=kv_num_blocks)
+                             num_blocks=kv_num_blocks,
+                             kv_sharding=kv_sh, len_sharding=len_sh)
         self.cache = self.paged.cache
         self._free: list[int] = list(range(max_batch))
         self._active: dict[int, GenRequest] = {}     # slot -> request

@@ -35,9 +35,12 @@ from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
 
 
 def init_paged_cache(cfg: llama.LlamaConfig, max_batch: int, max_seq: int,
-                     block_size: int, num_blocks: int, dtype=None) -> dict:
+                     block_size: int, num_blocks: int, dtype=None,
+                     kv_sharding=None, len_sharding=None) -> dict:
     """Pool + per-slot lengths. ``num_blocks`` bounds total resident tokens
-    (num_blocks * block_size), independent of max_batch * max_seq."""
+    (num_blocks * block_size), independent of max_batch * max_seq.
+    ``kv_sharding`` allocates the pool DIRECTLY with that sharding — a
+    pod-sized pool must never transit one chip unsharded."""
     if max_seq % block_size:
         raise ValueError(f"max_seq={max_seq} not a multiple of "
                          f"block_size={block_size}")
@@ -45,9 +48,9 @@ def init_paged_cache(cfg: llama.LlamaConfig, max_batch: int, max_seq: int,
     shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
              cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
-        "len": jnp.zeros((max_batch,), jnp.int32),
+        "k": jnp.zeros(shape, dtype, device=kv_sharding),
+        "v": jnp.zeros(shape, dtype, device=kv_sharding),
+        "len": jnp.zeros((max_batch,), jnp.int32, device=len_sharding),
     }
 
 
@@ -96,11 +99,14 @@ class PagedKV:
     block_size: int
     num_blocks: int
     prefix_cache: bool = True
+    kv_sharding: object = None       # NamedSharding for the pool k/v
+    len_sharding: object = None
 
     def __post_init__(self):
         self.cache = init_paged_cache(
             self.cfg, self.max_batch, self.max_seq, self.block_size,
-            self.num_blocks)
+            self.num_blocks, kv_sharding=self.kv_sharding,
+            len_sharding=self.len_sharding)
         self.max_blocks_per_seq = self.max_seq // self.block_size
         self.tables = np.zeros(
             (self.max_batch, self.max_blocks_per_seq), np.int32)

@@ -22,6 +22,9 @@ Env contract (all optional except the uri for real weights):
   KFT_DTYPE         "bfloat16" | "float32"   (default bfloat16)
   KFT_MAX_BATCH / KFT_MAX_SEQ    engine sizing
   KFT_COMPILE_CACHE persistent XLA compile cache dir
+  KFT_MESH          e.g. "tensor=4": shard params + KV pool over the
+                    pod's chips (distributed serving; same topology-env
+                    contract as training rendezvous)
 """
 
 from __future__ import annotations
@@ -62,8 +65,16 @@ def build_model_from_env(env: Mapping[str, str]) -> Model:
             raise ValueError("llama format needs KFT_STORAGE_URI/KFT_MODEL_DIR")
         dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                  "float16": jnp.float16}[env.get("KFT_DTYPE", "bfloat16")]
+        # KFT_MESH (e.g. "tensor=4") turns on sharded serving: params and
+        # the KV pool distribute over the pod's chips, same topology-env
+        # contract the training rendezvous uses
+        mesh = None
+        if env.get("KFT_MESH"):
+            from kubeflow_tpu.parallel import mesh_from_topology_env
+
+            mesh = mesh_from_topology_env(dict(env))
         return LLMModel.from_pretrained(
-            name, model_dir, dtype=dtype,
+            name, model_dir, dtype=dtype, mesh=mesh,
             max_batch=int(env.get("KFT_MAX_BATCH", 8)),
             max_seq=int(env.get("KFT_MAX_SEQ", 1024)),
             compile_cache_dir=cache)

@@ -525,3 +525,37 @@ def test_llm_model_timeout_aborts(tiny):
         assert out.shape == (1, 3)
     finally:
         model.unload()
+
+
+def test_tensor_parallel_engine_matches_reference(tiny):
+    """TP-sharded serving: params sharded by the logical-axis rules over a
+    `tensor` axis, KV pool sharded on the kv-head dim — XLA auto-partitions
+    the same jitted prefill/decode programs (SPMD over the mesh) and the
+    outputs must stay greedy-consistent with the unsharded reference."""
+    from kubeflow_tpu.parallel import MeshConfig, build_mesh
+    from kubeflow_tpu.parallel.sharding import tree_shardings
+
+    cfg, params = tiny
+    mesh = build_mesh(MeshConfig(tensor=2))
+    shardings = tree_shardings(mesh, llama.param_logical_axes(cfg))
+    tp_params = jax.device_put(params, shardings)
+    eng = LLMEngine(tp_params, cfg, max_batch=4, max_seq=64,
+                    prefill_buckets=(8, 16), mesh=mesh)
+    # the KV pool really is distributed over the tensor axis
+    assert len(eng.cache["k"].sharding.device_set) == 8
+    spec = eng.cache["k"].sharding.spec
+    assert spec[3] == "tensor"
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13], [3] * 12]
+    reqs = eng.generate(prompts, SamplingParams(max_tokens=6))
+    for r in reqs:
+        assert_greedy_consistent(params, cfg, r.prompt, r.generated)
+
+
+def test_tensor_parallel_engine_rejects_indivisible_heads(tiny):
+    from kubeflow_tpu.parallel import MeshConfig, build_mesh
+
+    cfg, params = tiny   # n_kv_heads=2
+    mesh = build_mesh(MeshConfig(tensor=4))
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                  prefill_buckets=(8,), mesh=mesh)

@@ -319,3 +319,28 @@ def test_subsecond_recurring_runs_get_unique_ids(tmp_path):
         fired = c.tick(now=1e9)       # same wall-clock instant every time
         ids += [r.run_id for r in fired]
     assert len(ids) == 3 and len(set(ids)) == 3
+
+
+def test_odd_pipeline_names_still_run(tmp_path):
+    """Strict run_id validation applies only to CLIENT-supplied ids:
+    auto-generated ids sanitize legal-but-odd pipeline names."""
+    from kubeflow_tpu.pipelines import dsl
+    from kubeflow_tpu.pipelines.example_components import summarize
+
+    @dsl.pipeline(name="my pipeline (v2)")
+    def odd():
+        summarize(n=2, scale=1.0)
+
+    c = _client(tmp_path, "w1")
+    c.upload_pipeline(odd)
+    run = c.create_run("my pipeline (v2)")
+    assert run.state == TaskState.SUCCEEDED
+    assert "/" not in run.run_id and " " not in run.run_id
+    rid = c.create_run_async("my pipeline (v2)")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        r = c.get_run(rid)
+        if r is not None and r.state == TaskState.SUCCEEDED:
+            break
+        time.sleep(0.05)
+    assert c.get_run(rid).state == TaskState.SUCCEEDED

@@ -406,3 +406,30 @@ def test_multi_model_runtime_hot_loads(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_runtime_env_mesh_tensor_parallel_serving(tmp_path):
+    """KFT_MESH=tensor=2 in the predictor env contract -> params + KV pool
+    sharded over the mesh, text still comes out (distributed serving is
+    the same env-driven path as single-chip)."""
+    from kubeflow_tpu.serving.runtime import build_model_from_env
+
+    model_dir, cfg, _, tok = _fixture_checkpoint(tmp_path)
+    model = build_model_from_env({
+        "KFT_MODEL_NAME": "tp", "KFT_MODEL_FORMAT": "llama",
+        "KFT_MODEL_DIR": str(model_dir), "KFT_DTYPE": "float32",
+        "KFT_MAX_BATCH": "2", "KFT_MAX_SEQ": "128",
+        "KFT_MESH": "tensor=2",
+    })
+    try:
+        assert model.load()
+        k = model.engine.cache["k"]
+        assert len(k.sharding.device_set) == 8
+        assert k.sharding.spec[3] == "tensor"
+        req = InferRequest.from_v1(
+            "tp", {"instances": ["hello"],
+                   "parameters": {"max_tokens": 4}})
+        texts = model(req).as_numpy("text")
+        assert texts.shape == (1,) and isinstance(texts[0], str)
+    finally:
+        model.unload()
