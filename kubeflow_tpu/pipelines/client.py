@@ -222,7 +222,10 @@ class PipelineClient:
                         break
         out = list(runs.values())
         if pipeline:
-            out = [r for r in out if r.run_id.startswith(pipeline)]
+            # run ids embed the SANITIZED pipeline name (odd-but-legal
+            # names are rewritten), so the filter must sanitize too
+            pfx = sanitize_run_component(pipeline)
+            out = [r for r in out if r.run_id.startswith(pfx)]
         return sorted(out, key=lambda r: r.run_id)
 
     # ---------------- recurring runs ----------------

@@ -120,7 +120,9 @@ class LLMEngine:
                  kv_num_blocks: Optional[int] = None,
                  decode_chunk: int = 8,
                  mesh=None):
-        from kubeflow_tpu.serving.paged_kv import PagedKV
+        from kubeflow_tpu.serving.paged_kv import (
+            PagedKV, paged_prefill_chunk as paged_prefill_chunk_fn,
+        )
 
         self.params = params
         self.cfg = cfg
@@ -194,6 +196,14 @@ class LLMEngine:
         self._prefill = jax.jit(
             lambda p, toks, lens, cache: llama.prefill(
                 p, toks, cfg, cache, lengths=lens))
+        # chunked prefill for prompts longer than every bucket: fixed
+        # chunk size (the largest bucket) + traced offset/length keep the
+        # compile count O(1) in prompt length
+        self._prefill_chunk = jax.jit(
+            lambda p, toks, cache, tables, slot, offset, length:
+                paged_prefill_chunk_fn(
+                    p, toks, self.cfg, cache, tables, slot, offset, length),
+            donate_argnums=(2,))
         # first-token sampling + its logprob in ONE jitted call: computing
         # log_softmax eagerly per admitted request costs an op-by-op
         # full-vocab dispatch + transfer (catastrophic on a remote chip)
@@ -252,13 +262,9 @@ class LLMEngine:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + 1 > self.max_seq:
+            # prompts beyond the largest bucket stream through CHUNKED
+            # prefill (paged_prefill_chunk); max_seq is the only cap
             raise ValueError(f"prompt too long for max_seq={self.max_seq}")
-        if len(prompt) > self.buckets[-1]:
-            # reject HERE (caller's thread), not inside the scheduler loop —
-            # an exception in _admit would kill the engine for everyone
-            raise ValueError(
-                f"prompt length {len(prompt)} exceeds largest prefill "
-                f"bucket {self.buckets[-1]}")
         if sampling is not None:
             # a reservation that can NEVER succeed must fail fast here —
             # re-queueing it would spin generate()'s drain loop forever
@@ -368,6 +374,24 @@ class LLMEngine:
 
     # ---------------- internals ----------------
 
+    def _admit_chunked(self, req, slot: int):
+        """Stream a long prompt through the pool in fixed-size chunks
+        (chunked prefill). Returns the final chunk's logits (read at the
+        prompt's true last row). The slot's cache len stays 0 until the
+        caller publishes it, so partial writes are invisible to decode."""
+        chunk = self.buckets[-1]
+        L = len(req.prompt)
+        logits = None
+        tables = jnp.asarray(self.paged.tables)
+        for c0 in range(0, L, chunk):
+            piece = np.zeros((1, chunk), np.int32)
+            part = req.prompt[c0:c0 + chunk]
+            piece[0, :len(part)] = part
+            logits, self.cache = self._prefill_chunk(
+                self.params, jnp.asarray(piece), self.cache, tables,
+                jnp.int32(slot), jnp.int32(c0), jnp.int32(L))
+        return logits
+
     def _admit(self) -> None:
         from kubeflow_tpu.serving.paged_kv import blocks_for
 
@@ -383,23 +407,30 @@ class LLMEngine:
             # Full prompt blocks already cached (same tokens, same
             # positions) are SHARED, not recomputed storage.
             bs = self.paged.block_size
+            chunked = len(req.prompt) > self.buckets[-1]
             nb_prefill = blocks_for(len(req.prompt), bs)
-            n_shared = self.paged.reserve(slot, len(req.prompt),
-                                          req.sampling.max_tokens,
-                                          min_blocks=nb_prefill,
-                                          prompt=req.prompt)
+            # chunked prompts skip prefix SHARING: the chunk writer scatters
+            # every row it computes, and shared blocks must never be
+            # rewritten while other slots read them
+            n_shared = self.paged.reserve(
+                slot, len(req.prompt), req.sampling.max_tokens,
+                min_blocks=nb_prefill,
+                prompt=None if chunked else req.prompt)
             if n_shared is None:
                 with self._lock:
                     self._waiting.insert(0, req)
                 self._free.append(slot)
                 return
-            bucket = _bucket(len(req.prompt), self.buckets)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :len(req.prompt)] = req.prompt
-            scratch = llama.init_cache(self.cfg, 1, bucket)
-            logits, filled = self._prefill(
-                self.params, jnp.asarray(toks),
-                jnp.asarray([len(req.prompt)], jnp.int32), scratch)
+            if chunked:
+                logits = self._admit_chunked(req, slot)
+            else:
+                bucket = _bucket(len(req.prompt), self.buckets)
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :len(req.prompt)] = req.prompt
+                scratch = llama.init_cache(self.cfg, 1, bucket)
+                logits, filled = self._prefill(
+                    self.params, jnp.asarray(toks),
+                    jnp.asarray([len(req.prompt)], jnp.int32), scratch)
             self._rng, rng = jax.random.split(self._rng)
             first, first_lp_arr = self._first_sample(
                 logits, rng,
@@ -408,20 +439,27 @@ class LLMEngine:
                 jnp.asarray([req.sampling.top_p], jnp.float32))
             first_tok = int(np.asarray(first)[0])
             first_lp = float(np.asarray(first_lp_arr)[0])
-            # write only the blocks covering the true prompt length (pad
-            # rows past them are never attended), and within those skip the
-            # shared prefix blocks — their identical KV is already resident
-            blk_ids = self.paged.slot_blocks(slot)[n_shared:nb_prefill]
-            if blk_ids:
-                self.cache = self._insert(
-                    self.cache,
-                    filled["k"][:, :, n_shared * bs:nb_prefill * bs],
-                    filled["v"][:, :, n_shared * bs:nb_prefill * bs],
-                    jnp.asarray(blk_ids, jnp.int32),
-                    jnp.int32(len(req.prompt)), jnp.int32(slot))
-            else:
+            if chunked:
+                # KV already sits in the pool; just publish the length
                 self.cache = self._set_len(
                     self.cache, jnp.int32(len(req.prompt)), jnp.int32(slot))
+            else:
+                # write only the blocks covering the true prompt length
+                # (pad rows past them are never attended), and within those
+                # skip the shared prefix blocks — their identical KV is
+                # already resident
+                blk_ids = self.paged.slot_blocks(slot)[n_shared:nb_prefill]
+                if blk_ids:
+                    self.cache = self._insert(
+                        self.cache,
+                        filled["k"][:, :, n_shared * bs:nb_prefill * bs],
+                        filled["v"][:, :, n_shared * bs:nb_prefill * bs],
+                        jnp.asarray(blk_ids, jnp.int32),
+                        jnp.int32(len(req.prompt)), jnp.int32(slot))
+                else:
+                    self.cache = self._set_len(
+                        self.cache, jnp.int32(len(req.prompt)),
+                        jnp.int32(slot))
             # the prefill-sampled token is generation token #1; decode
             # continues from it
             req.generated.append(first_tok)

@@ -261,6 +261,35 @@ def paged_insert(cache, k_new, v_new, blk_ids, length, slot):
     return {"k": k, "v": v, "len": ln}
 
 
+def _layer_qkv(lp, x, positions, cfg, inv_freq):
+    """Shared attention-input path for the paged decode AND chunked-prefill
+    layer bodies — one place for the projection/rope math so the two paths
+    cannot drift."""
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    return q, k, v
+
+
+def _layer_out(lp, x, o, cfg):
+    """Shared attention-output + FFN path (see _layer_qkv)."""
+    o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+    x = x + o
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    down, _ = llama._ffn(h, lp, cfg)
+    return x + down
+
+
+def _lm_head(params, x_last, cfg):
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return jnp.einsum("bd,dv->bv", x_last,
+                      head.astype(cfg.dtype)).astype(jnp.float32)
+
+
 def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
     """One decode step over the paged pool. token: [B] int32; tables:
     [B, max_blocks_per_seq] int32 -> (logits [B, V], cache)."""
@@ -280,12 +309,7 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
 
     def block_fn(x, xs):
         lp, k_pool, v_pool = xs                          # [NB, bs, KV, D]
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q, k, v = _layer_qkv(lp, x, positions, cfg, inv_freq)
         # scatter this step's KV row into each slot's current block
         k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
@@ -294,18 +318,80 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
         k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
         v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
         o = decode_attention(q, k_view, v_view, pos + 1)
-        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
-        x = x + o
-        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        down, _ = llama._ffn(h, lp, cfg)
-        x = x + down
-        return x, (k_pool, v_pool)
+        return _layer_out(lp, x, o, cfg), (k_pool, v_pool)
 
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
-    return logits.astype(jnp.float32), {
-        "k": new_k, "v": new_v, "len": cache["len"] + 1
-    }
+    logits = _lm_head(params, x[:, 0], cfg)
+    return logits, {"k": new_k, "v": new_v, "len": cache["len"] + 1}
+
+
+def _chunk_attention(q, k_view, v_view, q_positions):
+    """Causal attention for a prefill chunk against a slot's logical KV
+    view. q: [1, C, H, D]; k_view/v_view: [1, S, KV, D]; q_positions:
+    [C] int32 absolute positions (query row i may attend kv rows
+    <= q_positions[i]). O(C*S) scores — C is the chunk size, bounded."""
+    _, c, h, d = q.shape
+    kvh = k_view.shape[2]
+    groups = h // kvh
+    qf = q.astype(jnp.float32).reshape(1, c, kvh, groups, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qf * scale,
+                        k_view.astype(jnp.float32))
+    mask = (jnp.arange(k_view.shape[1])[None, :]
+            <= q_positions[:, None])                       # [C, S]
+    scores = jnp.where(mask[None, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs,
+                     v_view.astype(jnp.float32))
+    return out.reshape(1, c, h, d).astype(q.dtype)
+
+
+def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
+                        tables, slot, offset, length):
+    """Chunked prefill straight into the paged pool (vLLM chunked-prefill
+    role): processes `tokens` [1, C] as positions offset..offset+C-1 of
+    `slot`'s sequence, attending to everything the slot's blocks already
+    hold. No dense scratch cache exists — prompts longer than any prefill
+    bucket (up to max_seq) stream through in fixed-size chunks, so the
+    compile count stays O(1) in prompt length (offset/length are traced).
+
+    Rows at positions >= `length` (the final chunk's padding) scatter to
+    block 0 — the pool's scratch block — never into live data. Returns
+    (logits [1, V] read at the chunk's last TRUE row — meaningful only
+    for the final chunk — and the updated cache). cache["len"] for the
+    slot is NOT advanced here; the engine sets it once after the last
+    chunk (decode masks by len, so partial writes stay invisible)."""
+    _, c = tokens.shape
+    bs = cache["k"].shape[2]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+    pos = offset + jnp.arange(c)                          # [C] absolute
+    valid = pos < length
+    # destination rows: real rows land in the slot's table blocks; pad
+    # rows land in scratch block 0 (row p % bs — garbage, never read)
+    blk = jnp.where(
+        valid,
+        tables[slot, jnp.clip(pos // bs, 0, tables.shape[1] - 1)],
+        0)
+    off = pos % bs
+    positions = pos[None, :]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def block_fn(x, xs):
+        lp, k_pool, v_pool = xs
+        q, k, v = _layer_qkv(lp, x, positions, cfg, inv_freq)
+        k_pool = k_pool.at[blk, off].set(k[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v[0].astype(v_pool.dtype))
+        k_view = k_pool[tables[slot]].reshape(1, -1, *k_pool.shape[2:])
+        v_view = v_pool[tables[slot]].reshape(1, -1, *v_pool.shape[2:])
+        o = _chunk_attention(q, k_view, v_view, pos)
+        return _layer_out(lp, x, o, cfg), (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["layers"], cache["k"], cache["v"]))
+    last_row = jnp.clip(length - offset - 1, 0, c - 1)
+    logits = _lm_head(params, x[:, last_row], cfg)
+    return logits, {"k": new_k, "v": new_v, "len": cache["len"]}

@@ -344,3 +344,5 @@ def test_odd_pipeline_names_still_run(tmp_path):
             break
         time.sleep(0.05)
     assert c.get_run(rid).state == TaskState.SUCCEEDED
+    # listing filters by the SANITIZED name the run ids embed
+    assert len(c.list_runs(pipeline="my pipeline (v2)")) == 2
