@@ -1,0 +1,131 @@
+"""Launcher components — pipeline tasks that drive the platform's own APIs.
+
+The reference's KFP "launcher component" pattern (SURVEY.md §3.4: the
+target config is a pipeline task that *submits a training CR and waits*,
+BASELINE.md milestone #5). These are module-level ``@dsl.component``
+functions, so IR-submitted pipelines can reference them by fnRef — a
+pipeline POSTed to the operator can launch training jobs and HPO sweeps
+on that same operator.
+
+Connection comes from the ``operator_url`` argument or the
+``KFT_OPERATOR_URL`` env the pipeline pod carries; ``KFT_OPERATOR_TOKEN``
+adds a bearer token when the API runs with auth.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.pipelines import dsl
+
+
+def _api(base: str, path: str, payload: bytes | None = None,
+         method: str = "GET") -> dict:
+    import json
+    import os
+    import urllib.request
+
+    req = urllib.request.Request(base + path, data=payload, method=method)
+    token = os.environ.get("KFT_OPERATOR_TOKEN")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read().decode() or "{}")
+
+
+def _base(operator_url: str) -> str:
+    import os
+
+    base = operator_url or os.environ.get("KFT_OPERATOR_URL", "")
+    if not base:
+        raise ValueError(
+            "no operator endpoint: pass operator_url or set KFT_OPERATOR_URL")
+    return base.rstrip("/")
+
+
+@dsl.component(name="run-training-job", cache=False)
+def run_training_job(job_yaml: str, operator_url: str = "",
+                     namespace: str = "",
+                     timeout_s: float = 600.0,
+                     poll_s: float = 0.5) -> dict:
+    """Submit a job spec (YAML) to the operator and wait for completion.
+
+    Returns the final job document on success; raises on Failed/timeout so
+    the task's retry policy and the run state see the failure. Caching is
+    off: submitting a training job is an effect, not a pure function."""
+    import time
+
+    from kubeflow_tpu.api.types import from_yaml
+
+    base = _base(operator_url)
+    spec = from_yaml(job_yaml)
+    ns = namespace or spec.namespace or "default"
+    _api(base, f"/apis/v1/namespaces/{ns}/jobs",
+         payload=job_yaml.encode(), method="POST")
+    deadline = time.time() + timeout_s
+    doc: dict = {}
+    while time.time() < deadline:
+        doc = _api(base, f"/apis/v1/namespaces/{ns}/jobs/{spec.name}")
+        if doc.get("condition") in ("Succeeded", "Failed"):
+            break
+        time.sleep(poll_s)
+    if doc.get("condition") != "Succeeded":
+        raise RuntimeError(
+            f"job {ns}/{spec.name} did not succeed: "
+            f"condition={doc.get('condition')!r} "
+            f"restarts={doc.get('restart_count')}")
+    return doc
+
+
+@dsl.component(name="run-experiment", cache=False)
+def run_experiment(experiment: dict, trial_template: str,
+                   operator_url: str = "", namespace: str = "",
+                   timeout_s: float = 900.0, poll_s: float = 0.5) -> dict:
+    """Submit an HPO experiment (spec dict + trial-template YAML) and wait
+    for it to finish. Returns the final experiment document (including
+    best_trial); raises when the sweep fails."""
+    import json
+    import time
+
+    base = _base(operator_url)
+    ns = namespace or experiment.get("namespace") or "default"
+    name = experiment["name"]
+    _api(base, f"/apis/v1/namespaces/{ns}/experiments",
+         payload=json.dumps({"experiment": experiment,
+                             "trial_template": trial_template}).encode(),
+         method="POST")
+    deadline = time.time() + timeout_s
+    doc: dict = {}
+    while time.time() < deadline:
+        doc = _api(base, f"/apis/v1/namespaces/{ns}/experiments/{name}")
+        if doc.get("succeeded") or doc.get("failed"):
+            break
+        time.sleep(poll_s)
+    if not doc.get("succeeded"):
+        raise RuntimeError(
+            f"experiment {ns}/{name} did not succeed: "
+            f"{doc.get('completion_reason')!r}")
+    return doc
+
+
+@dsl.component(name="deploy-inference-service", cache=False)
+def deploy_inference_service(service: dict, operator_url: str = "",
+                             namespace: str = "",
+                             timeout_s: float = 300.0,
+                             poll_s: float = 0.5) -> dict:
+    """Apply an InferenceService spec and wait until it reports ready —
+    the train→deploy pipeline tail (SURVEY.md §3.4's deploy step)."""
+    import json
+    import time
+
+    base = _base(operator_url)
+    ns = namespace or service.get("namespace") or "default"
+    name = service["name"]
+    _api(base, f"/apis/v1/namespaces/{ns}/inferenceservices",
+         payload=json.dumps(service).encode(), method="POST")
+    deadline = time.time() + timeout_s
+    doc: dict = {}
+    while time.time() < deadline:
+        doc = _api(base, f"/apis/v1/namespaces/{ns}/inferenceservices/{name}")
+        if doc.get("ready"):
+            return doc
+        time.sleep(poll_s)
+    raise RuntimeError(f"inference service {ns}/{name} never became ready")

@@ -121,7 +121,8 @@ class LLMEngine:
                  decode_chunk: int = 8,
                  mesh=None):
         from kubeflow_tpu.serving.paged_kv import (
-            PagedKV, paged_prefill_chunk as paged_prefill_chunk_fn,
+            PagedKV, _lm_head as lm_head_fn,
+            paged_prefill_chunk as paged_prefill_chunk_fn,
         )
 
         self.params = params
@@ -204,6 +205,10 @@ class LLMEngine:
                 paged_prefill_chunk_fn(
                     p, toks, self.cfg, cache, tables, slot, offset, length),
             donate_argnums=(2,))
+        # the lm head runs ONCE on the final chunk's hidden row, not per
+        # chunk (full-vocab matmul is the expensive part of short chunks)
+        self._chunk_lm_head = jax.jit(
+            lambda p, x_last: lm_head_fn(p, x_last, self.cfg))
         # first-token sampling + its logprob in ONE jitted call: computing
         # log_softmax eagerly per admitted request costs an op-by-op
         # full-vocab dispatch + transfer (catastrophic on a remote chip)
@@ -381,16 +386,16 @@ class LLMEngine:
         caller publishes it, so partial writes are invisible to decode."""
         chunk = self.buckets[-1]
         L = len(req.prompt)
-        logits = None
+        x_last = None
         tables = jnp.asarray(self.paged.tables)
         for c0 in range(0, L, chunk):
             piece = np.zeros((1, chunk), np.int32)
             part = req.prompt[c0:c0 + chunk]
             piece[0, :len(part)] = part
-            logits, self.cache = self._prefill_chunk(
+            x_last, self.cache = self._prefill_chunk(
                 self.params, jnp.asarray(piece), self.cache, tables,
                 jnp.int32(slot), jnp.int32(c0), jnp.int32(L))
-        return logits
+        return self._chunk_lm_head(self.params, x_last)
 
     def _admit(self) -> None:
         from kubeflow_tpu.serving.paged_kv import blocks_for

@@ -326,27 +326,6 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
     return logits, {"k": new_k, "v": new_v, "len": cache["len"] + 1}
 
 
-def _chunk_attention(q, k_view, v_view, q_positions):
-    """Causal attention for a prefill chunk against a slot's logical KV
-    view. q: [1, C, H, D]; k_view/v_view: [1, S, KV, D]; q_positions:
-    [C] int32 absolute positions (query row i may attend kv rows
-    <= q_positions[i]). O(C*S) scores — C is the chunk size, bounded."""
-    _, c, h, d = q.shape
-    kvh = k_view.shape[2]
-    groups = h // kvh
-    qf = q.astype(jnp.float32).reshape(1, c, kvh, groups, d)
-    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    scores = jnp.einsum("bqkgd,bskd->bkgqs", qf * scale,
-                        k_view.astype(jnp.float32))
-    mask = (jnp.arange(k_view.shape[1])[None, :]
-            <= q_positions[:, None])                       # [C, S]
-    scores = jnp.where(mask[None, None, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bqkgd", probs,
-                     v_view.astype(jnp.float32))
-    return out.reshape(1, c, h, d).astype(q.dtype)
-
-
 def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
                         tables, slot, offset, length):
     """Chunked prefill straight into the paged pool (vLLM chunked-prefill
@@ -358,10 +337,12 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
 
     Rows at positions >= `length` (the final chunk's padding) scatter to
     block 0 — the pool's scratch block — never into live data. Returns
-    (logits [1, V] read at the chunk's last TRUE row — meaningful only
-    for the final chunk — and the updated cache). cache["len"] for the
-    slot is NOT advanced here; the engine sets it once after the last
-    chunk (decode masks by len, so partial writes stay invisible)."""
+    (x_last [1, D]: the post-norm hidden state at the chunk's last TRUE
+    row — the caller runs the lm head ONCE on the final chunk's value
+    rather than paying a full-vocab matmul per chunk — and the updated
+    cache). cache["len"] for the slot is NOT advanced here; the engine
+    sets it once after the last chunk (decode masks by len, so partial
+    writes stay invisible)."""
     _, c = tokens.shape
     bs = cache["k"].shape[2]
     inv_freq = jnp.asarray(rope_frequencies(
@@ -380,6 +361,8 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
     positions = pos[None, :]
     x = params["embed"].astype(cfg.dtype)[tokens]
 
+    from kubeflow_tpu.ops.attention import _xla_attention
+
     def block_fn(x, xs):
         lp, k_pool, v_pool = xs
         q, k, v = _layer_qkv(lp, x, positions, cfg, inv_freq)
@@ -387,11 +370,12 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
         v_pool = v_pool.at[blk, off].set(v[0].astype(v_pool.dtype))
         k_view = k_pool[tables[slot]].reshape(1, -1, *k_pool.shape[2:])
         v_view = v_pool[tables[slot]].reshape(1, -1, *v_pool.shape[2:])
-        o = _chunk_attention(q, k_view, v_view, pos)
+        # the shared GQA causal kernel with traced query offset: row i
+        # (absolute position offset+i) attends kv rows <= offset+i
+        o = _xla_attention(q, k_view, v_view, causal=True, q_offset=offset)
         return _layer_out(lp, x, o, cfg), (k_pool, v_pool)
 
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
     last_row = jnp.clip(length - offset - 1, 0, c - 1)
-    logits = _lm_head(params, x[:, last_row], cfg)
-    return logits, {"k": new_k, "v": new_v, "len": cache["len"]}
+    return x[:, last_row], {"k": new_k, "v": new_v, "len": cache["len"]}

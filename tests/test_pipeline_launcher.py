@@ -1,0 +1,165 @@
+"""Launcher components: a pipeline task that submits a training job /
+experiment to the operator and waits (the KFP launcher-component pattern,
+SURVEY.md §3.4 + BASELINE milestone #5 'Pipelines DAG -> JAXJob'). The
+flagship test POSTs the pipeline IR to the daemon and the daemon-run
+pipeline launches a real subprocess job on that same daemon."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from kubeflow_tpu.api.types import jax_job, to_yaml
+from kubeflow_tpu.pipelines import compile_pipeline, dsl
+from kubeflow_tpu.pipelines.components import (
+    run_experiment, run_training_job,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _job_yaml(ok: bool = True) -> str:
+    job = jax_job("launched", workers=1)
+    job.replica_specs["Worker"].template.command = [
+        sys.executable, "-c",
+        "print('launched job ran')" if ok else "import sys; sys.exit(1)"]
+    job.run_policy.backoff_limit = 0
+    return to_yaml(job)
+
+
+@dsl.pipeline(name="train-then-report")
+def train_then_report(job_yaml: str = "", operator_url: str = ""):
+    run_training_job(job_yaml=job_yaml, operator_url=operator_url,
+                     timeout_s=120.0)
+
+
+def _start_daemon(tmp_path):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.controller", "serve",
+         "--cluster", "local", "--port", "0",
+         "--state-dir", str(tmp_path / "state"),
+         "--log-dir", str(tmp_path / "pods")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT})
+    port = None
+    deadline = time.time() + 60
+    while port is None and time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"serving on [\w.]+:(\d+)", line)
+        if m:
+            port = int(m.group(1))
+    assert port, "daemon never bound"
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _req(url, method="GET", payload=None, raw=None):
+    data = raw if raw is not None else (
+        json.dumps(payload).encode() if payload is not None else None)
+    req = urllib.request.Request(url, method=method, data=data)
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read().decode() or "{}")
+
+
+def test_daemon_runs_pipeline_that_launches_job(tmp_path):
+    """IR -> daemon -> pipeline run -> launcher component -> real job on
+    the same daemon: the whole reference architecture in one loop."""
+    proc, base = _start_daemon(tmp_path)
+    try:
+        _req(f"{base}/apis/v1/pipelines", "POST",
+             raw=yaml.safe_dump(compile_pipeline(train_then_report)).encode())
+        body = _req(f"{base}/apis/v1/pipelines/train-then-report/runs",
+                    "POST", payload={"arguments": {
+                        "job_yaml": _job_yaml(), "operator_url": base}})
+        run_id = body["run_id"]
+        state = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            try:
+                run = _req(f"{base}/apis/v1/pipelines/runs/{run_id}")
+            except urllib.error.HTTPError:
+                time.sleep(0.3)
+                continue
+            state = run["state"]
+            if state in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.3)
+        assert state == "Succeeded", run
+        # the launched job exists on the daemon and succeeded
+        job = _req(f"{base}/apis/v1/namespaces/default/jobs/launched")
+        assert job["condition"] == "Succeeded"
+    finally:
+        proc.send_signal(__import__("signal").SIGTERM)
+        proc.wait(timeout=15)
+
+
+def test_launcher_failure_fails_the_run(tmp_path):
+    """A job that exits nonzero must fail the component (and the run)."""
+    from kubeflow_tpu.pipelines.runner import LocalRunner, TaskState
+
+    proc, base = _start_daemon(tmp_path)
+    try:
+        runner = LocalRunner(workdir=str(tmp_path / "wd"))
+        res = runner.run(train_then_report, arguments={
+            "job_yaml": _job_yaml(ok=False), "operator_url": base})
+        assert res.state == TaskState.FAILED
+        (task,) = res.tasks.values()
+        assert "did not succeed" in task.error
+    finally:
+        proc.send_signal(__import__("signal").SIGTERM)
+        proc.wait(timeout=15)
+
+
+def test_experiment_launcher_component(tmp_path):
+    """run_experiment submits an HPO sweep through the operator API and
+    returns the finished experiment with its best trial."""
+    from kubeflow_tpu.hpo.persistence import experiment_spec_to_dict
+    from kubeflow_tpu.hpo.types import (
+        AlgorithmSpec, Experiment, ObjectiveSpec, ParameterSpec,
+        ParameterType,
+    )
+    from kubeflow_tpu.pipelines.runner import LocalRunner, TaskState
+
+    script = ("import json, os\n"
+              "x = float(os.environ['TRIAL_X'])\n"
+              "rec = {'step': 1, 'ts': 0.0, 'loss': (x - 0.3) ** 2}\n"
+              "open(os.environ['KFT_METRICS_PATH'], 'a').write("
+              "json.dumps(rec) + '\\n')\n")
+    trial = jax_job("template", workers=1)
+    trial.replica_specs["Worker"].template.command = [
+        sys.executable, "-c", script]
+    trial.replica_specs["Worker"].template.env = {
+        "TRIAL_X": "${x}", "PYTHONPATH": REPO_ROOT}
+    exp = Experiment(
+        name="sweep-x",
+        parameters=[ParameterSpec("x", ParameterType.DOUBLE, min=0.0,
+                                  max=1.0)],
+        objective=ObjectiveSpec(metric_name="loss"),
+        algorithm=AlgorithmSpec(name="grid"),
+        parallel_trial_count=2, max_trial_count=4)
+
+    @dsl.pipeline(name="tune")
+    def tune(operator_url: str = ""):
+        run_experiment(experiment=experiment_spec_to_dict(exp),
+                       trial_template=to_yaml(trial),
+                       operator_url=operator_url, timeout_s=180.0)
+
+    proc, base = _start_daemon(tmp_path)
+    try:
+        runner = LocalRunner(workdir=str(tmp_path / "wd"))
+        res = runner.run(tune, arguments={"operator_url": base})
+        assert res.state == TaskState.SUCCEEDED, res.tasks
+        (task,) = res.tasks.values()
+        doc = task.outputs["Output"]
+        assert doc["succeeded"] and doc["best_trial"] is not None
+    finally:
+        proc.send_signal(__import__("signal").SIGTERM)
+        proc.wait(timeout=15)
