@@ -131,6 +131,13 @@ class Operator:
         # Pipelines are platform-scoped (not namespaced) like the
         # reference's shared pipeline store; PipelineClient self-locks.
         self.pipelines = pipeline_client
+        # data-plane ingress (istio gateway role): /serving/{ns}/{name}/...
+        # proxied to a traffic-split-chosen predictor pod
+        self.ingress = None
+        if serving_ticker is not None:
+            from kubeflow_tpu.serving.ingress import IngressGateway
+
+            self.ingress = IngressGateway(serving_ticker.controller)
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (
@@ -396,6 +403,11 @@ def _make_http_server(op: Operator, port: int,
                       host: str = "127.0.0.1"
                       ) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
+        # 1.1: keep-alive + honest chunked framing for proxied SSE streams
+        # (a 1.0 status line with Transfer-Encoding: chunked is malformed
+        # for spec-compliant clients)
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *args):  # quiet
             pass
 
@@ -456,6 +468,38 @@ def _make_http_server(op: Operator, port: int,
                 return parts[3:]
             return None
 
+        def _maybe_proxy(self, method: str, body=None) -> bool:
+            """Route /serving/{ns}/{name}/<rest> through the ingress
+            gateway. Data-plane access needs only read rights in the
+            namespace (inference is a 'get', whatever the HTTP verb)."""
+            parts = self.path.split("?")[0].strip("/").split("/")
+            if op.ingress is None or len(parts) < 4 \
+                    or parts[0] != "serving":
+                return False
+            ns, name = parts[1], parts[2]
+            rest = "/".join(parts[3:])
+            if op.auth is not None:
+                res = op.auth.check(
+                    self.headers.get("Authorization"), "GET", ns)
+                if not res.allowed:
+                    self._send(res.status, json.dumps({"error": res.reason}))
+                    return True
+            self.proxy_headers_sent = False
+            try:
+                op.ingress.proxy(self, method, ns, name, rest, body)
+            except Exception as e:
+                if not getattr(self, "proxy_headers_sent", False):
+                    try:
+                        self._send(502, json.dumps({"error": str(e)}))
+                    except Exception:
+                        pass
+                else:
+                    # headers (and possibly chunks) already went out: a 502
+                    # injected mid-stream would corrupt the framing — drop
+                    # the connection so the client sees a truncated stream
+                    self.close_connection = True
+            return True
+
         def _path_namespace(self):
             parts = self.path.strip("/").split("/")
             if (len(parts) >= 4 and parts[0] == "apis" and parts[1] == "v1"
@@ -481,6 +525,8 @@ def _make_http_server(op: Operator, port: int,
             if self.path == "/metrics":
                 return self._send(200, op.metrics.render(), "text/plain")
             if not self._authorized():
+                return
+            if self._maybe_proxy("GET"):
                 return
             if op.webui is not None and (
                     self.path == "/ui" or self.path.startswith("/ui/")):
@@ -553,9 +599,18 @@ def _make_http_server(op: Operator, port: int,
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length).decode()
+            raw = self.rfile.read(length)
             if not self._authorized():
                 return
+            # proxy BEFORE decoding: inference payloads may be binary
+            # (v2 tensor data); only the control-plane routes are text
+            if self._maybe_proxy("POST", raw):
+                return
+            try:
+                body = raw.decode()
+            except UnicodeDecodeError:
+                return self._send(
+                    400, '{"error": "control-plane body must be UTF-8"}')
             if op.webui is not None and self.path.startswith("/ui/"):
                 return self._webui("POST", body)
             ns, _ = self._job_path()

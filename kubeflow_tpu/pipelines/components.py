@@ -55,11 +55,26 @@ def run_training_job(job_yaml: str, operator_url: str = "",
 
     from kubeflow_tpu.api.types import from_yaml
 
+    import urllib.error
+
     base = _base(operator_url)
     spec = from_yaml(job_yaml)
     ns = namespace or spec.namespace or "default"
-    _api(base, f"/apis/v1/namespaces/{ns}/jobs",
-         payload=job_yaml.encode(), method="POST")
+    try:
+        _api(base, f"/apis/v1/namespaces/{ns}/jobs",
+             payload=job_yaml.encode(), method="POST")
+    except urllib.error.HTTPError as e:
+        # idempotent retries: on a name collision from an earlier attempt,
+        # delete a terminally-FAILED leftover and resubmit; a live or
+        # succeeded one is simply polled (submit-once semantics)
+        if b"already exists" not in e.read():
+            raise
+        doc = _api(base, f"/apis/v1/namespaces/{ns}/jobs/{spec.name}")
+        if doc.get("condition") == "Failed":
+            _api(base, f"/apis/v1/namespaces/{ns}/jobs/{spec.name}",
+                 method="DELETE")
+            _api(base, f"/apis/v1/namespaces/{ns}/jobs",
+                 payload=job_yaml.encode(), method="POST")
     deadline = time.time() + timeout_s
     doc: dict = {}
     while time.time() < deadline:
@@ -85,13 +100,21 @@ def run_experiment(experiment: dict, trial_template: str,
     import json
     import time
 
+    import urllib.error
+
     base = _base(operator_url)
     ns = namespace or experiment.get("namespace") or "default"
     name = experiment["name"]
-    _api(base, f"/apis/v1/namespaces/{ns}/experiments",
-         payload=json.dumps({"experiment": experiment,
-                             "trial_template": trial_template}).encode(),
-         method="POST")
+    try:
+        _api(base, f"/apis/v1/namespaces/{ns}/experiments",
+             payload=json.dumps({"experiment": experiment,
+                                 "trial_template": trial_template}).encode(),
+             method="POST")
+    except urllib.error.HTTPError as e:
+        # retry after a partial earlier attempt: the sweep is resumable,
+        # so an existing experiment is polled rather than resubmitted
+        if b"already exists" not in e.read():
+            raise
     deadline = time.time() + timeout_s
     doc: dict = {}
     while time.time() < deadline:

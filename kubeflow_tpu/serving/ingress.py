@@ -1,0 +1,106 @@
+"""Data-plane ingress for serving — the istio-VirtualService / Knative
+route role (SURVEY.md §3.3: 'client → Istio ingress gateway → … predictor').
+
+One stable endpoint per platform routes ``/serving/{ns}/{isvc}/<rest>`` to
+a live predictor pod, choosing the REVISION per request by the service's
+traffic split — so canary percentages are enforced at the data plane, not
+just recorded in status. Within a revision, requests spread across its
+running predictor pods.
+
+The proxy streams: responses without a Content-Length (SSE token streams,
+chunked bodies) are forwarded chunk-by-chunk as they arrive.
+"""
+
+from __future__ import annotations
+
+import http.client
+import random
+from typing import Optional
+
+from kubeflow_tpu.controller.cluster import PodPhase
+
+
+class IngressGateway:
+    """Revision-weighted router over a ServingController's pods."""
+
+    def __init__(self, controller, seed: int = 0):
+        self.controller = controller
+        self._rng = random.Random(seed)
+
+    def pick_backend(self, namespace: str, name: str) -> Optional[str]:
+        """-> 'host:port' of a predictor pod chosen by the traffic split,
+        or None when the service has no routable backend."""
+        isvc = self.controller.get(namespace, name)
+        if isvc is None or not isvc.status.traffic:
+            return None
+        entries = [(rev, w) for rev, w in isvc.status.traffic.items()
+                   if w > 0]
+        if not entries:
+            return None
+        revs, weights = zip(*entries)
+        # try the drawn revision first, then the rest by weight — a canary
+        # with no live pod must not 503 the request the split sent it
+        order = sorted(
+            revs, key=lambda r: -isvc.status.traffic[r])
+        drawn = self._rng.choices(revs, weights=weights)[0]
+        order.remove(drawn)
+        for rev in [drawn] + order:
+            pods = [
+                p for p in self.controller._pods(isvc, revision=rev)
+                if p.labels.get("component") == "predictor"
+                and p.phase == PodPhase.RUNNING and p.env.get("KFT_BIND")
+            ]
+            if pods:
+                return self._rng.choice(pods).env["KFT_BIND"]
+        return None
+
+    def proxy(self, handler, method: str, namespace: str, name: str,
+              rest: str, body: Optional[bytes]) -> None:
+        """Forward one request to a chosen backend, streaming the response
+        through ``handler`` (a BaseHTTPRequestHandler)."""
+        backend = self.pick_backend(namespace, name)
+        if backend is None:
+            payload = b'{"error": "no ready backend"}'
+            handler.send_response(503)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return
+        host, _, port = backend.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=600)
+        try:
+            headers = {}
+            ctype = handler.headers.get("Content-Type")
+            if ctype:
+                headers["Content-Type"] = ctype
+            accept = handler.headers.get("Accept")
+            if accept:
+                headers["Accept"] = accept
+            conn.request(method, "/" + rest, body=body, headers=headers)
+            resp = conn.getresponse()
+            handler.proxy_headers_sent = True   # past here, no clean 502
+            handler.send_response(resp.status)
+            clen = resp.getheader("Content-Length")
+            rtype = resp.getheader("Content-Type")
+            if rtype:
+                handler.send_header("Content-Type", rtype)
+            if clen is not None:
+                handler.send_header("Content-Length", clen)
+                handler.end_headers()
+                handler.wfile.write(resp.read())
+            else:
+                # streaming (SSE / chunked): forward as it arrives. The
+                # outer hop re-chunks; token-by-token latency is preserved.
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    handler.wfile.write(
+                        f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                    handler.wfile.flush()
+                handler.wfile.write(b"0\r\n\r\n")
+        finally:
+            conn.close()

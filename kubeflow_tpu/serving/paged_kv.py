@@ -337,10 +337,10 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
 
     Rows at positions >= `length` (the final chunk's padding) scatter to
     block 0 — the pool's scratch block — never into live data. Returns
-    (x_last [1, D]: the post-norm hidden state at the chunk's last TRUE
-    row — the caller runs the lm head ONCE on the final chunk's value
-    rather than paying a full-vocab matmul per chunk — and the updated
-    cache). cache["len"] for the slot is NOT advanced here; the engine
+    (x_last [1, D]: the PRE-final-norm hidden state at the chunk's last
+    TRUE row — _lm_head applies final_norm; the caller runs it ONCE on
+    the final chunk's value rather than paying a full-vocab matmul per
+    chunk — and the updated cache). cache["len"] for the slot is NOT advanced here; the engine
     sets it once after the last chunk (decode masks by len, so partial
     writes stay invisible)."""
     _, c = tokens.shape

@@ -1,0 +1,164 @@
+"""Ingress gateway: revision-weighted canary routing enforced at the data
+plane (SURVEY.md §3.3 istio-gateway/Knative-route role) + streaming proxy
+through the operator."""
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.controller import Operator
+from kubeflow_tpu.controller.cluster import FakeCluster, Pod, PodPhase
+from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.serving.controller import (
+    RuntimeRegistry, ServingController, ServingTicker, Autoscaler,
+)
+from kubeflow_tpu.serving.ingress import IngressGateway
+from kubeflow_tpu.serving.types import (
+    InferenceService, ModelFormat, PredictorSpec, ServingRuntime,
+)
+
+
+def _backend(payload: bytes, sse: bool = False):
+    """Tiny live HTTP server playing a predictor pod."""
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _respond(self):
+            if sse:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                for i in range(3):
+                    self.wfile.write(f"data: tok{i}\n\n".encode())
+                    self.wfile.flush()
+                return
+            body = payload
+            if self.command == "POST":
+                n = int(self.headers.get("Content-Length", 0))
+                body = payload + b":" + self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = do_POST = _respond
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+def _isvc_with_revisions(cluster, ctrl, binds: dict[int, str],
+                         traffic: dict[int, int]):
+    """Manufacture an ISVC whose revision pods point at live backends."""
+    registry = RuntimeRegistry()
+    registry.register(ServingRuntime(
+        name="rt", supported_formats=[ModelFormat("jax")], command=["x"]))
+    isvc = InferenceService(
+        name="m", predictor=PredictorSpec(model_format=ModelFormat("jax")))
+    ctrl.services[("default", "m")] = isvc
+    isvc.status.traffic = dict(traffic)
+    isvc.status.ready = True
+    for rev, bind in binds.items():
+        pod = Pod(
+            name=f"m-predictor-rev{rev}-0", namespace="default",
+            labels={"isvc": "m", "component": "predictor",
+                    "revision": str(rev)},
+            env={"KFT_BIND": bind}, command=[])
+        pod.phase = PodPhase.RUNNING
+        cluster.create_pod(pod)
+    return isvc
+
+
+def test_traffic_split_distribution():
+    cluster = FakeCluster()
+    ctrl = ServingController(cluster, RuntimeRegistry())
+    _isvc_with_revisions(cluster, ctrl,
+                         binds={1: "h1:1", 2: "h2:2"},
+                         traffic={1: 75, 2: 25})
+    gw = IngressGateway(ctrl, seed=7)
+    picks = [gw.pick_backend("default", "m") for _ in range(400)]
+    frac2 = sum(1 for p in picks if p == "h2:2") / len(picks)
+    assert 0.15 < frac2 < 0.35, frac2          # ~25% to the canary
+    assert set(picks) == {"h1:1", "h2:2"}
+
+
+def test_canary_without_live_pod_falls_back():
+    """The split may draw a revision with no running pod (rollout window);
+    the request must route to a live revision, not 503."""
+    cluster = FakeCluster()
+    ctrl = ServingController(cluster, RuntimeRegistry())
+    _isvc_with_revisions(cluster, ctrl,
+                         binds={1: "h1:1"},          # rev 2 has NO pod
+                         traffic={1: 10, 2: 90})
+    gw = IngressGateway(ctrl, seed=3)
+    assert all(gw.pick_backend("default", "m") == "h1:1"
+               for _ in range(50))
+
+
+def test_no_backend_is_none():
+    ctrl = ServingController(FakeCluster(), RuntimeRegistry())
+    gw = IngressGateway(ctrl)
+    assert gw.pick_backend("default", "absent") is None
+
+
+@pytest.fixture()
+def gateway_op():
+    cluster = FakeCluster()
+    serving = ServingTicker(
+        ServingController(cluster, RuntimeRegistry()), Autoscaler())
+    op = Operator(JobController(cluster), serving_ticker=serving,
+                  reconcile_period=0.05)
+    port = op.start(port=0)
+    yield op, cluster, serving.controller, f"http://127.0.0.1:{port}"
+    op.stop()
+
+
+def test_operator_proxies_by_traffic_split(gateway_op):
+    op, cluster, ctrl, base = gateway_op
+    srv1, bind1 = _backend(b'"rev1"')
+    srv2, bind2 = _backend(b'"rev2"')
+    try:
+        _isvc_with_revisions(cluster, ctrl, binds={1: bind1, 2: bind2},
+                             traffic={1: 100})
+        body = urllib.request.urlopen(
+            f"{base}/serving/default/m/v1/models/m:predict").read()
+        assert body == b'"rev1"'
+        # flip all traffic to the canary: the data plane follows
+        ctrl.get("default", "m").status.traffic = {2: 100}
+        body = urllib.request.urlopen(
+            f"{base}/serving/default/m/v1/models/m:predict").read()
+        assert body == b'"rev2"'
+        # POST bodies pass through
+        req = urllib.request.Request(
+            f"{base}/serving/default/m/v2/models/m/infer",
+            data=b'{"x":1}', method="POST",
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req).read() == b'"rev2":{"x":1}'
+        # unknown service -> 503 from the gateway
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/serving/default/nope/v1/x")
+        assert e.value.code == 503
+    finally:
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def test_operator_proxies_sse_stream(gateway_op):
+    op, cluster, ctrl, base = gateway_op
+    srv, bind = _backend(b"", sse=True)
+    try:
+        _isvc_with_revisions(cluster, ctrl, binds={1: bind},
+                             traffic={1: 100})
+        with urllib.request.urlopen(
+                f"{base}/serving/default/m/v1/models/m:stream") as r:
+            assert r.headers.get("Content-Type") == "text/event-stream"
+            text = r.read().decode()
+        assert text == "data: tok0\n\ndata: tok1\n\ndata: tok2\n\n"
+    finally:
+        srv.shutdown()

@@ -163,3 +163,28 @@ def test_experiment_launcher_component(tmp_path):
     finally:
         proc.send_signal(__import__("signal").SIGTERM)
         proc.wait(timeout=15)
+
+
+def test_launcher_retry_after_failed_job_resubmits(tmp_path):
+    """The component's retry contract: a terminally-FAILED leftover job
+    from an earlier attempt is deleted and resubmitted; a succeeded one is
+    polled, not re-run."""
+    from kubeflow_tpu.pipelines.dsl import component as _c  # noqa: F401
+    from kubeflow_tpu.pipelines.components import run_training_job
+
+    proc, base = _start_daemon(tmp_path)
+    try:
+        bad = _job_yaml(ok=False)
+        with pytest.raises(RuntimeError, match="did not succeed"):
+            run_training_job.spec.fn(bad, operator_url=base, timeout_s=60)
+        # second attempt with a FIXED spec under the SAME name: the failed
+        # leftover must not block it
+        good = _job_yaml(ok=True)
+        doc = run_training_job.spec.fn(good, operator_url=base, timeout_s=60)
+        assert doc["condition"] == "Succeeded"
+        # third call: job already Succeeded -> polled, returns immediately
+        doc = run_training_job.spec.fn(good, operator_url=base, timeout_s=60)
+        assert doc["condition"] == "Succeeded"
+    finally:
+        proc.send_signal(__import__("signal").SIGTERM)
+        proc.wait(timeout=15)
