@@ -137,7 +137,9 @@ class Operator:
         if serving_ticker is not None:
             from kubeflow_tpu.serving.ingress import IngressGateway
 
-            self.ingress = IngressGateway(serving_ticker.controller)
+            self.ingress = IngressGateway(
+                serving_ticker.controller,
+                autoscaler=serving_ticker.autoscaler)
         self.metrics = Metrics()
         self.heartbeat_dir = heartbeat_dir
         self.tracker = (

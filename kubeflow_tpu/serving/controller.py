@@ -338,6 +338,9 @@ class ServingTicker:
                 isvc = self.controller.reconcile(ns, name)
             if self.autoscaler is None or isvc is None:
                 continue
+            # a scaled-to-zero service keeps status.ready (its revision
+            # wants zero pods), so the activator wake path passes this
+            # guard; only genuinely not-ready services are left alone
             if not isvc.status.ready:
                 continue
             concurrency = self.concurrency_of(isvc)     # unlocked HTTP
@@ -357,6 +360,14 @@ class Autoscaler:
     def __init__(self, idle_grace_seconds: float = 30.0):
         self.idle_grace = idle_grace_seconds
         self._last_busy: dict[tuple[str, str], float] = {}
+
+    def wake(self, namespace: str, name: str,
+             now: Optional[float] = None) -> None:
+        """Activator signal (Knative activator role): a request arrived
+        for a possibly scaled-to-zero service — mark it busy so the next
+        scale() returns at least one replica."""
+        self._last_busy[(namespace, name)] = (
+            time.time() if now is None else now)
 
     def scale(self, isvc: InferenceService, concurrency: float,
               now: Optional[float] = None) -> int:

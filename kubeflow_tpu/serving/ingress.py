@@ -21,10 +21,19 @@ from kubeflow_tpu.controller.cluster import PodPhase
 
 
 class IngressGateway:
-    """Revision-weighted router over a ServingController's pods."""
+    """Revision-weighted router over a ServingController's pods.
 
-    def __init__(self, controller, seed: int = 0):
+    With an ``autoscaler``, the gateway also plays the Knative ACTIVATOR:
+    a request for a service with no live backend (scaled to zero) wakes
+    the autoscaler and holds the request until a pod comes up (the daemon
+    ticker applies the scale), up to ``wake_timeout_s``."""
+
+    def __init__(self, controller, seed: int = 0, autoscaler=None,
+                 wake_timeout_s: float = 60.0, wake_poll_s: float = 0.2):
         self.controller = controller
+        self.autoscaler = autoscaler
+        self.wake_timeout_s = wake_timeout_s
+        self.wake_poll_s = wake_poll_s
         self._rng = random.Random(seed)
 
     def pick_backend(self, namespace: str, name: str) -> Optional[str]:
@@ -54,11 +63,41 @@ class IngressGateway:
                 return self._rng.choice(pods).env["KFT_BIND"]
         return None
 
+    def _activate(self, namespace: str, name: str) -> Optional[str]:
+        """Scale-from-zero on request: wake the autoscaler, keep it awake,
+        and wait for a backend (the activator's hold-the-request path).
+
+        Engages ONLY for a service actually scaled to zero — a broken
+        service (crash-looping pod, no matching runtime) must keep its
+        fast 503, not tie a handler thread up for wake_timeout_s."""
+        import time
+
+        isvc = self.controller.get(namespace, name)
+        if self.autoscaler is None or isvc is None:
+            return None
+        if self.controller._predictor_replicas(isvc) != 0:
+            return None
+        deadline = time.time() + self.wake_timeout_s
+        while time.time() < deadline:
+            # deleted mid-hold: fail fast and stop re-seeding autoscaler
+            # state the controller's delete() has already reset
+            if self.controller.get(namespace, name) is None:
+                return None
+            # re-wake each poll: the cold start may outlast the idle grace
+            self.autoscaler.wake(namespace, name)
+            backend = self.pick_backend(namespace, name)
+            if backend is not None:
+                return backend
+            time.sleep(self.wake_poll_s)
+        return None
+
     def proxy(self, handler, method: str, namespace: str, name: str,
               rest: str, body: Optional[bytes]) -> None:
         """Forward one request to a chosen backend, streaming the response
         through ``handler`` (a BaseHTTPRequestHandler)."""
         backend = self.pick_backend(namespace, name)
+        if backend is None:
+            backend = self._activate(namespace, name)
         if backend is None:
             payload = b'{"error": "no ready backend"}'
             handler.send_response(503)

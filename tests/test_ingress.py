@@ -162,3 +162,70 @@ def test_operator_proxies_sse_stream(gateway_op):
         assert text == "data: tok0\n\ndata: tok1\n\ndata: tok2\n\n"
     finally:
         srv.shutdown()
+
+
+def test_scale_from_zero_activator(gateway_op):
+    """Knative activator role: a request for a scaled-to-zero service
+    wakes the autoscaler, the daemon ticker brings a pod up, and the held
+    request completes — no 503."""
+    import time
+
+    op, cluster, ctrl, base = gateway_op
+    srv, bind = _backend(b'"cold"')
+    try:
+        ctrl.runtimes.register(ServingRuntime(
+            name="rt", supported_formats=[ModelFormat("jax")],
+            command=["x"]))
+        ctrl.apply(InferenceService(
+            name="z", predictor=PredictorSpec(
+                model_format=ModelFormat("jax"), min_replicas=0,
+                max_replicas=2)))
+        isvc = ctrl.get("default", "z")
+        assert not [p for p in cluster.pods.values()
+                    if p.labels.get("isvc") == "z"]      # truly at zero
+
+        result = {}
+
+        def request():
+            try:
+                result["body"] = urllib.request.urlopen(
+                    f"{base}/serving/default/z/v1/models/z:predict",
+                    timeout=60).read()
+            except Exception as e:   # surfaced by the main thread
+                result["error"] = e
+
+        t = threading.Thread(target=request)
+        t.start()
+        # the kubelet role: once the ticker scales up and the controller
+        # creates the pod, point it at the live backend and mark it running
+        deadline = time.time() + 30
+        pod = None
+        while time.time() < deadline and pod is None:
+            pods = [p for p in cluster.pods.values()
+                    if p.labels.get("isvc") == "z"
+                    and p.labels.get("component") == "predictor"]
+            pod = pods[0] if pods else None
+            time.sleep(0.05)
+        assert pod is not None, "activator never triggered scale-up"
+        pod.env["KFT_BIND"] = bind
+        pod.phase = PodPhase.RUNNING
+        t.join(timeout=60)
+        assert result.get("body") == b'"cold"', result
+    finally:
+        srv.shutdown()
+
+
+def test_activator_only_engages_at_zero(gateway_op):
+    """A broken service with replicas > 0 keeps its fast 503 — the
+    activator must not hold the request for wake_timeout_s."""
+    import time
+
+    op, cluster, ctrl, base = gateway_op
+    # a service whose revision exists but whose pod never comes up
+    _isvc_with_revisions(cluster, ctrl, binds={}, traffic={1: 100})
+    ctrl._desired[("default", "m")] = 1          # not scaled to zero
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(f"{base}/serving/default/m/v1/x")
+    assert e.value.code == 503
+    assert time.time() - t0 < 5.0                # fast, not a 60s hold
