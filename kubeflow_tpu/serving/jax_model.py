@@ -281,6 +281,7 @@ class LLMModel(Model):
         return {
             "generated_tokens_total": eng.generated_tokens,
             "decode_steps_total": eng.steps,
+            "prefill_dispatches_total": eng.prefill_dispatches,
             "active_requests": len(eng._active),
             "waiting_requests": len(eng._waiting),
             "kv_free_blocks": eng.paged.allocator.free_blocks,

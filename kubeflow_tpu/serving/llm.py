@@ -187,6 +187,7 @@ class LLMEngine:
         self._rng = jax.random.key(0)
         self.steps = 0
         self.generated_tokens = 0
+        self.prefill_dispatches = 0       # observability: admission batching
         # multi-step decode: one dispatch runs `decode_chunk` decode+sample
         # steps under lax.scan, amortizing host->device dispatch latency
         # (vLLM multistep role). Requests finishing mid-chunk are trimmed on
@@ -218,7 +219,8 @@ class LLMEngine:
                 jnp.take_along_axis(logits, tok[:, None], axis=-1)[:, 0]
                 - jax.nn.logsumexp(logits, axis=-1)))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
-        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._insert_batch = jax.jit(self._insert_batch_impl,
+                                     donate_argnums=(0,))
         self._set_len = jax.jit(
             lambda cache, length, slot: {
                 **cache, "len": cache["len"].at[slot].set(length)},
@@ -251,10 +253,12 @@ class LLMEngine:
             one_step, (token, cache), rngs)
         return toks, lps, cache                  # toks/lps: [chunk, B]
 
-    def _insert_impl(self, cache, k_new, v_new, blk_ids, length, slot):
-        from kubeflow_tpu.serving.paged_kv import paged_insert
+    def _insert_batch_impl(self, cache, k_new, v_new, blk_ids, lengths,
+                           slots):
+        from kubeflow_tpu.serving.paged_kv import paged_insert_batch
 
-        return paged_insert(cache, k_new, v_new, blk_ids, length, slot)
+        return paged_insert_batch(cache, k_new, v_new, blk_ids, lengths,
+                                  slots)
 
     # ---------------- public API ----------------
 
@@ -400,6 +404,7 @@ class LLMEngine:
     def _admit(self) -> None:
         from kubeflow_tpu.serving.paged_kv import blocks_for
 
+        bs = self.paged.block_size
         while True:
             with self._lock:
                 if not self._waiting or not self._free:
@@ -411,15 +416,13 @@ class LLMEngine:
             # under memory pressure — later arrivals must not starve it).
             # Full prompt blocks already cached (same tokens, same
             # positions) are SHARED, not recomputed storage.
-            bs = self.paged.block_size
             chunked = len(req.prompt) > self.buckets[-1]
-            nb_prefill = blocks_for(len(req.prompt), bs)
             # chunked prompts skip prefix SHARING: the chunk writer scatters
             # every row it computes, and shared blocks must never be
             # rewritten while other slots read them
             n_shared = self.paged.reserve(
                 slot, len(req.prompt), req.sampling.max_tokens,
-                min_blocks=nb_prefill,
+                min_blocks=blocks_for(len(req.prompt), bs),
                 prompt=None if chunked else req.prompt)
             if n_shared is None:
                 with self._lock:
@@ -428,56 +431,105 @@ class LLMEngine:
                 return
             if chunked:
                 logits = self._admit_chunked(req, slot)
-            else:
-                bucket = _bucket(len(req.prompt), self.buckets)
-                toks = np.zeros((1, bucket), np.int32)
-                toks[0, :len(req.prompt)] = req.prompt
-                scratch = llama.init_cache(self.cfg, 1, bucket)
-                logits, filled = self._prefill(
-                    self.params, jnp.asarray(toks),
-                    jnp.asarray([len(req.prompt)], jnp.int32), scratch)
-            self._rng, rng = jax.random.split(self._rng)
-            first, first_lp_arr = self._first_sample(
-                logits, rng,
-                jnp.asarray([req.sampling.temperature], jnp.float32),
-                jnp.asarray([req.sampling.top_k], jnp.int32),
-                jnp.asarray([req.sampling.top_p], jnp.float32))
-            first_tok = int(np.asarray(first)[0])
-            first_lp = float(np.asarray(first_lp_arr)[0])
-            if chunked:
-                # KV already sits in the pool; just publish the length
+                tok, lp = self._sample_rows(logits, [req])
                 self.cache = self._set_len(
                     self.cache, jnp.int32(len(req.prompt)), jnp.int32(slot))
-            else:
-                # write only the blocks covering the true prompt length
-                # (pad rows past them are never attended), and within those
-                # skip the shared prefix blocks — their identical KV is
-                # already resident
-                blk_ids = self.paged.slot_blocks(slot)[n_shared:nb_prefill]
-                if blk_ids:
-                    self.cache = self._insert(
-                        self.cache,
-                        filled["k"][:, :, n_shared * bs:nb_prefill * bs],
-                        filled["v"][:, :, n_shared * bs:nb_prefill * bs],
-                        jnp.asarray(blk_ids, jnp.int32),
-                        jnp.int32(len(req.prompt)), jnp.int32(slot))
-                else:
-                    self.cache = self._set_len(
-                        self.cache, jnp.int32(len(req.prompt)),
-                        jnp.int32(slot))
-            # the prefill-sampled token is generation token #1; decode
-            # continues from it
-            req.generated.append(first_tok)
-            req.logprobs.append(first_lp)
-            self.generated_tokens += 1
-            req.slot = slot
-            self._tokens[slot] = first_tok
-            self._active[slot] = req
-            eos = req.sampling.eos_id
-            if (eos is not None and first_tok == eos) or \
-                    first_tok in req.sampling.stop_token_ids or \
-                    req.sampling.max_tokens <= 1:
-                req.done = True
-                del self._active[slot]
-                self.paged.release(slot)
-                self._free.append(slot)
+                self._post_admit(req, slot, int(tok[0]), float(lp[0]))
+                continue
+            # batched admission: take the FIFO prefix of same-bucket
+            # requests and pay ONE prefill+insert+sample dispatch for all
+            # of them (admission is RTT-bound on a remote chip)
+            bucket = _bucket(len(req.prompt), self.buckets)
+            batch = [(req, slot, n_shared)]
+            while len(batch) < self.max_batch:
+                with self._lock:
+                    if not self._waiting or not self._free:
+                        break
+                    nxt = self._waiting[0]
+                    if len(nxt.prompt) > self.buckets[-1] or \
+                            _bucket(len(nxt.prompt),
+                                    self.buckets) != bucket:
+                        break
+                    self._waiting.pop(0)
+                    s2 = self._free.pop()
+                ns2 = self.paged.reserve(
+                    s2, len(nxt.prompt), nxt.sampling.max_tokens,
+                    min_blocks=blocks_for(len(nxt.prompt), bs),
+                    prompt=nxt.prompt)
+                if ns2 is None:
+                    with self._lock:
+                        self._waiting.insert(0, nxt)
+                    self._free.append(s2)
+                    break
+                batch.append((nxt, s2, ns2))
+            self._admit_prefill_batch(batch, bucket)
+
+    def _admit_prefill_batch(self, batch, bucket: int) -> None:
+        """One prefill + insert + first-token sample for a same-bucket
+        admission batch. Rows pad to the next power of two (compile count
+        log2(max_batch) per bucket) so the steady-state single-request
+        admission does ~1 row of work, not max_batch rows; pad rows carry
+        slot -1 and their writes land in the scratch block / are dropped."""
+        from kubeflow_tpu.serving.paged_kv import blocks_for
+
+        bs = self.paged.block_size
+        width = min(self.max_batch, 1 << (len(batch) - 1).bit_length())
+        nbmax = bucket // bs
+        toks = np.zeros((width, bucket), np.int32)
+        lengths = np.ones((width,), np.int32)       # pad rows: safe index
+        blk = np.zeros((width, nbmax), np.int32)
+        slots = np.full((width,), -1, np.int32)
+        for i, (req, slot, n_shared) in enumerate(batch):
+            toks[i, :len(req.prompt)] = req.prompt
+            lengths[i] = len(req.prompt)
+            nb_prefill = blocks_for(len(req.prompt), bs)
+            ids = self.paged.slot_blocks(slot)
+            blk[i, n_shared:nb_prefill] = ids[n_shared:nb_prefill]
+            slots[i] = slot
+        scratch = llama.init_cache(self.cfg, width, bucket)
+        self.prefill_dispatches += 1
+        logits, filled = self._prefill(
+            self.params, jnp.asarray(toks), jnp.asarray(lengths), scratch)
+        self.cache = self._insert_batch(
+            self.cache, filled["k"], filled["v"], jnp.asarray(blk),
+            jnp.asarray(lengths), jnp.asarray(slots))
+        tok, lp = self._sample_rows(logits, [r for r, _, _ in batch],
+                                    width=width)
+        for i, (req, slot, _) in enumerate(batch):
+            self._post_admit(req, slot, int(tok[i]), float(lp[i]))
+
+    def _sample_rows(self, logits, reqs, width: Optional[int] = None):
+        """First-token sampling for admission rows (one jitted call)."""
+        width = width or len(reqs)
+        temp = np.zeros((width,), np.float32)
+        top_k = np.zeros((width,), np.int32)
+        top_p = np.ones((width,), np.float32)
+        for i, r in enumerate(reqs):
+            temp[i] = r.sampling.temperature
+            top_k[i] = r.sampling.top_k
+            top_p[i] = r.sampling.top_p
+        self._rng, rng = jax.random.split(self._rng)
+        tok, lp = self._first_sample(
+            logits, rng, jnp.asarray(temp), jnp.asarray(top_k),
+            jnp.asarray(top_p))
+        return np.asarray(tok), np.asarray(lp)
+
+    def _post_admit(self, req, slot: int, first_tok: int,
+                    first_lp: float) -> None:
+        """Per-request bookkeeping after its KV is resident: the
+        prefill-sampled token is generation token #1; decode continues
+        from it (or the request finishes instantly on eos/budget)."""
+        req.generated.append(first_tok)
+        req.logprobs.append(first_lp)
+        self.generated_tokens += 1
+        req.slot = slot
+        self._tokens[slot] = first_tok
+        self._active[slot] = req
+        eos = req.sampling.eos_id
+        if (eos is not None and first_tok == eos) or \
+                first_tok in req.sampling.stop_token_ids or \
+                req.sampling.max_tokens <= 1:
+            req.done = True
+            del self._active[slot]
+            self.paged.release(slot)
+            self._free.append(slot)

@@ -244,23 +244,6 @@ class PagedKV:
 
 # ------------------------------------------------------------ jitted bodies
 
-def paged_insert(cache, k_new, v_new, blk_ids, length, slot):
-    """Write a prefilled request's KV rows into its blocks.
-
-    k_new/v_new: [L, 1, T, KV, D] with T a multiple of block_size and
-    T == len(blk_ids) * block_size (the caller slices to the covered
-    blocks); blk_ids: [nb] int32 pool destinations."""
-    L = cache["k"].shape[0]
-    bs = cache["k"].shape[2]
-    nb = blk_ids.shape[0]
-    kb = k_new.reshape(L, nb, bs, *k_new.shape[3:]).astype(cache["k"].dtype)
-    vb = v_new.reshape(L, nb, bs, *v_new.shape[3:]).astype(cache["v"].dtype)
-    k = cache["k"].at[:, blk_ids].set(kb)
-    v = cache["v"].at[:, blk_ids].set(vb)
-    ln = cache["len"].at[slot].set(length)
-    return {"k": k, "v": v, "len": ln}
-
-
 def _layer_qkv(lp, x, positions, cfg, inv_freq):
     """Shared attention-input path for the paged decode AND chunked-prefill
     layer bodies — one place for the projection/rope math so the two paths
@@ -288,6 +271,31 @@ def _lm_head(params, x_last, cfg):
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     return jnp.einsum("bd,dv->bv", x_last,
                       head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def paged_insert_batch(cache, k_new, v_new, blk_ids, lengths, slots):
+    """Batched prefill insert: all admitted requests' KV lands in ONE
+    scatter (admission dispatches are RTT-bound on a remote chip).
+
+    k_new/v_new: [L, B, T, KV, D] with T == blk_ids.shape[1] * block_size;
+    blk_ids: [B, nb] pool destinations where id 0 means "skip this block"
+    (already-resident shared prefix blocks and pad regions — the scratch
+    block absorbs those writes); lengths/slots: [B] with slot < 0 marking
+    an inert pad row (its length write is redirected harmlessly)."""
+    L = cache["k"].shape[0]
+    bs = cache["k"].shape[2]
+    b, nb = blk_ids.shape
+    kb = k_new.reshape(L, b, nb, bs, *k_new.shape[3:]).astype(
+        cache["k"].dtype)
+    vb = v_new.reshape(L, b, nb, bs, *v_new.shape[3:]).astype(
+        cache["v"].dtype)
+    k = cache["k"].at[:, blk_ids].set(kb)
+    v = cache["v"].at[:, blk_ids].set(vb)
+    # pad rows: redirect to an out-of-range index and drop the write (a
+    # "safe" in-range redirect could collide with a real row's slot)
+    slots_drop = jnp.where(slots >= 0, slots, cache["len"].shape[0])
+    ln = cache["len"].at[slots_drop].set(lengths, mode="drop")
+    return {"k": k, "v": v, "len": ln}
 
 
 def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):

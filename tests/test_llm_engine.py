@@ -595,3 +595,26 @@ def test_chunked_prefill_releases_pool(tiny):
         1 for b in eng.paged._hash_of_block
         if eng.paged._ref.get(b, 0) == 0)
     assert free0 == free1
+
+
+def test_burst_admission_batches_prefill(tiny):
+    """A burst of same-bucket requests pays ONE prefill dispatch, not one
+    per request (admission is RTT-bound on a remote chip)."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=4, max_seq=64,
+                    prefill_buckets=(16,))
+    prompts = [[3 + i, 5, 7] for i in range(4)]
+    reqs = eng.generate(prompts, SamplingParams(max_tokens=4))
+    assert eng.prefill_dispatches == 1
+    for r in reqs:
+        assert_greedy_consistent(params, cfg, r.prompt, r.generated)
+    # mixed buckets split into one dispatch per bucket, FIFO order kept
+    eng2 = LLMEngine(params, cfg, max_batch=4, max_seq=64,
+                     prefill_buckets=(8, 16))
+    mixed = [[1, 2], [4] * 12, [3, 9], [5] * 12]
+    reqs = eng2.generate(mixed, SamplingParams(max_tokens=3))
+    # FIFO prefix batching never reorders: alternating buckets means one
+    # dispatch each
+    assert eng2.prefill_dispatches == 4
+    for r in reqs:
+        assert_greedy_consistent(params, cfg, r.prompt, r.generated)
