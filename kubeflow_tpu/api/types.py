@@ -43,6 +43,9 @@ class ConditionType(str, enum.Enum):
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
     SUSPENDED = "Suspended"
+    # advisory, never a phase: degraded-but-running signals from workers
+    # (e.g. a dead checkpoint mirror). Skipped by condition()/is_finished().
+    WARNING = "Warning"
 
 
 class ReplicaType(str, enum.Enum):
@@ -140,7 +143,16 @@ class JobStatus:
     restart_count: int = 0
 
     def condition(self) -> Optional[ConditionType]:
-        return self.conditions[-1].type if self.conditions else None
+        """Latest *phase* condition — Warning entries are advisory and never
+        define the job's phase."""
+        for c in reversed(self.conditions):
+            if c.type != ConditionType.WARNING:
+                return c.type
+        return None
+
+    def warnings(self) -> list[Condition]:
+        return [c for c in self.conditions
+                if c.type == ConditionType.WARNING]
 
     def is_finished(self) -> bool:
         return self.condition() in (ConditionType.SUCCEEDED, ConditionType.FAILED)

@@ -24,7 +24,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from kubeflow_tpu.api.types import from_yaml, to_yaml
+from kubeflow_tpu.api.types import Condition, ConditionType, from_yaml, to_yaml
 from kubeflow_tpu.controller.heartbeat import FileHeartbeatTracker, check_heartbeats
 from kubeflow_tpu.controller.reconciler import JobController
 
@@ -58,10 +58,26 @@ class Metrics:
         with self._lock:
             return self._counters.get(key, self._gauges.get(key))
 
+    @staticmethod
+    def _bare(key: str) -> str:
+        return key.split("{", 1)[0]
+
     def render(self) -> str:
+        """Prometheus exposition text, with # HELP/# TYPE headers so a real
+        scraper ingests it cleanly (one header per metric family)."""
         with self._lock:
-            lines = [f"{k} {v}" for k, v in sorted(self._counters.items())]
-            lines += [f"{k} {v}" for k, v in sorted(self._gauges.items())]
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+        lines: list[str] = []
+        for items, mtype in ((counters, "counter"), (gauges, "gauge")):
+            prev = None
+            for k, v in items:
+                bare = self._bare(k)
+                if bare != prev:
+                    lines.append(f"# HELP {bare} kubeflow_tpu {mtype}")
+                    lines.append(f"# TYPE {bare} {mtype}")
+                    prev = bare
+                lines.append(f"{k} {v}")
         return "\n".join(lines) + "\n"
 
 
@@ -153,6 +169,7 @@ class Operator:
         self.serving_period = serving_period
         self._submit_times: dict[tuple[str, str], float] = {}
         self._first_step_seen: set[tuple[str, str]] = set()
+        self._warn_offsets: dict[str, int] = {}     # warn file -> read pos
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -169,6 +186,10 @@ class Operator:
                 job = pod.labels.get("job-name", "")
                 pod.env.setdefault(
                     "KFT_HEARTBEAT_FILE", self.tracker.path_for(job, pod.name))
+                pod.env.setdefault(
+                    "KFT_WARNING_FILE",
+                    self._warning_path(job, pod.name,
+                                       pod.labels.get("job-uid", "")))
                 return pod
 
             controller.pod_mutator = mutator
@@ -256,6 +277,53 @@ class Operator:
                 if stale:
                     self.metrics.inc("kft_heartbeat_stale_total", by=len(stale))
                 self._record_first_step(ns, name)
+                self._collect_warnings(ns, name)
+
+    def _warning_path(self, job_name: str, pod_name: str, uid: str) -> str:
+        # uid-scoped: a deleted-and-resubmitted job (same names, new uid)
+        # must NOT inherit the previous incarnation's warnings
+        frag = f"-{uid[:8]}" if uid else ""
+        return os.path.join(
+            self.heartbeat_dir, f"{job_name}-{pod_name}{frag}.warn")
+
+    def _collect_warnings(self, ns: str, name: str):
+        """Worker warning files -> job Warning conditions + a metric. The
+        reverse of the heartbeat contract: heartbeats say 'alive', warning
+        lines say 'alive but degraded' (e.g. CheckpointMirrorDegraded) —
+        exactly the state to surface before the slice dies."""
+        job = self.controller.get(ns, name)
+        if job is None:
+            return
+        for pod in self.controller.cluster.list_pods(
+                ns, {"job-name": name, "job-uid": job.uid}):
+            if pod is None:
+                continue
+            path = self._warning_path(name, pod.name, job.uid)
+            pos = self._warn_offsets.get(path, 0)
+            try:
+                with open(path) as f:
+                    f.seek(pos)
+                    lines = f.readlines()
+                    self._warn_offsets[path] = f.tell()
+            except OSError:
+                continue
+            seen = {(c.reason, c.message) for c in job.status.warnings()}
+            for line in lines:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                reason = rec.get("reason", "WorkerWarning")
+                msg = rec.get("message", "")
+                if (reason, msg) in seen:
+                    continue
+                seen.add((reason, msg))
+                with self._lock:
+                    job.status.conditions.append(Condition(
+                        type=ConditionType.WARNING,
+                        reason=reason, message=msg))
+                self.metrics.inc(
+                    "kft_worker_warnings_total", labels={"reason": reason})
 
     def _record_first_step(self, ns: str, name: str):
         key = (ns, name)
@@ -452,6 +520,27 @@ def _make_http_server(op: Operator, port: int,
             self._send(resp.code, resp.body, resp.ctype,
                        location=resp.location)
 
+        def _same_site(self) -> bool:
+            """CSRF guard for the /ui HTML forms. Browsers never attach the
+            bearer Authorization header to a form POST, so the forms only
+            work with auth off — exactly the mode where a cross-origin page
+            could fire drive-by POSTs at a localhost daemon. Browsers stamp
+            cross-origin form posts with ``Sec-Fetch-Site: cross-site``
+            and an ``Origin`` header; header-less clients (curl, the test
+            suite, the SDK) are same-machine tools and pass."""
+            sfs = self.headers.get("Sec-Fetch-Site")
+            if sfs is not None and sfs not in (
+                    "same-origin", "same-site", "none"):
+                return False
+            origin = self.headers.get("Origin")
+            if origin and origin != "null":
+                host = (origin.split("://", 1)[-1]).rstrip("/")
+                if host != self.headers.get("Host", ""):
+                    return False
+            elif origin == "null":
+                return False
+            return True
+
         def _resource_path(self, kind: str):
             # /apis/v1/namespaces/{ns}/{kind}[/{name}]
             parts = self.path.strip("/").split("/")
@@ -474,12 +563,13 @@ def _make_http_server(op: Operator, port: int,
             """Route /serving/{ns}/{name}/<rest> through the ingress
             gateway. Data-plane access needs only read rights in the
             namespace (inference is a 'get', whatever the HTTP verb)."""
-            parts = self.path.split("?")[0].strip("/").split("/")
+            route, _, query = self.path.partition("?")
+            parts = route.strip("/").split("/")
             if op.ingress is None or len(parts) < 4 \
                     or parts[0] != "serving":
                 return False
             ns, name = parts[1], parts[2]
-            rest = "/".join(parts[3:])
+            rest = "/".join(parts[3:]) + (("?" + query) if query else "")
             if op.auth is not None:
                 res = op.auth.check(
                     self.headers.get("Authorization"), "GET", ns)
@@ -602,6 +692,13 @@ def _make_http_server(op: Operator, port: int,
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length)
+            if not self._same_site():
+                # CSRF guard for EVERY mutating route, not just /ui: with
+                # auth off, a cross-origin page could otherwise drive-by
+                # POST a JobSpec at the localhost daemon (fetch no-cors /
+                # text-plain form posts need no preflight)
+                return self._send(
+                    403, '{"error": "cross-site request rejected"}')
             if not self._authorized():
                 return
             # proxy BEFORE decoding: inference payloads may be binary
@@ -726,6 +823,9 @@ def _make_http_server(op: Operator, port: int,
             return False
 
         def do_DELETE(self):
+            if not self._same_site():
+                return self._send(
+                    403, '{"error": "cross-site request rejected"}')
             if not self._authorized():
                 return
             ns, name = self._job_path()

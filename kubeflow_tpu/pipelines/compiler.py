@@ -17,6 +17,25 @@ from kubeflow_tpu.pipelines import dsl
 
 IR_SCHEMA_VERSION = "kubeflow-tpu-ir/v1"
 
+# Module prefixes an IR fnRef may trigger an import of. Anything else must
+# already be imported by the hosting process — importing an arbitrary
+# attacker-named module would execute its top-level code as a side effect,
+# even though _resolve_fn later rejects non-Component targets.
+_COMPONENT_MODULE_PREFIXES: set[str] = {"kubeflow_tpu"}
+
+
+def allow_component_modules(*prefixes: str) -> None:
+    """Whitelist additional module prefixes for IR fnRef resolution."""
+    _COMPONENT_MODULE_PREFIXES.update(prefixes)
+
+
+def _module_allowed(mod_name: str) -> bool:
+    import sys
+    if mod_name in sys.modules:
+        return True
+    return any(mod_name == p or mod_name.startswith(p + ".")
+               for p in _COMPONENT_MODULE_PREFIXES)
+
 
 def _encode_value(v: Any) -> dict:
     if isinstance(v, dsl.OutputRef):
@@ -151,6 +170,11 @@ def _resolve_fn(fn_ref: str):
         raise ValueError(
             f"component fn {fn_ref!r} is not importable (defined inside a "
             "function); IR-submitted pipelines need module-level components")
+    if not _module_allowed(mod_name):
+        raise ValueError(
+            f"component module {mod_name!r} is neither already imported nor "
+            "under an allowed prefix (see allow_component_modules); "
+            "refusing to import it on behalf of an uploaded IR")
     obj = importlib.import_module(mod_name)
     for part in qual.split("."):
         obj = getattr(obj, part)

@@ -87,6 +87,13 @@ def main(argv=None) -> int:
                     help="run the storage-initializer step and exit")
     args = ap.parse_args(argv)
     env = os.environ
+    if env.get("KFT_FORCE_PLATFORM"):
+        # same contract as rendezvous.worker_check: a sitecustomize may
+        # pre-register a remote TPU platform and override JAX_PLATFORMS;
+        # config.update is the only thing that actually wins
+        import jax
+
+        jax.config.update("jax_platforms", env["KFT_FORCE_PLATFORM"])
     if args.init_only:
         path = init_storage(env)
         print(f"storage-initializer: materialized {path}", flush=True)

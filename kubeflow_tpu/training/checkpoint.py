@@ -31,6 +31,24 @@ import orbax.checkpoint as ocp
 _REMOTE_SCHEMES = ("gs://", "s3://")
 
 
+def _default_mirror_alarm(exc: Exception) -> None:
+    """Operator contract (mirrors KFT_HEARTBEAT_FILE): pods get
+    KFT_WARNING_FILE injected; appending a line raises a Warning condition
+    on the owning job — how a degraded mirror becomes visible before the
+    local disk it was guarding is actually needed."""
+    path = os.environ.get("KFT_WARNING_FILE")
+    if not path:
+        return
+    import json
+    import time
+    with open(path, "a") as f:
+        f.write(json.dumps({
+            "ts": time.time(),
+            "reason": "CheckpointMirrorDegraded",
+            "message": f"{type(exc).__name__}: {exc}",
+        }) + "\n")
+
+
 def _is_remote(path: str) -> bool:
     return path.startswith(_REMOTE_SCHEMES)
 
@@ -42,7 +60,9 @@ def _strip_file_scheme(path: str) -> str:
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = True, mirror: Optional[str] = None,
-                 copy_fn: Optional[Callable[[str, str], None]] = None):
+                 copy_fn: Optional[Callable[[str, str], None]] = None,
+                 on_mirror_error: Optional[Callable[[Exception], None]]
+                 = None):
         if _is_remote(directory):
             # bucket-direct: TensorStore owns the IO; no local mkdir
             self.directory = directory
@@ -52,6 +72,9 @@ class CheckpointManager:
         self.mirror = (_strip_file_scheme(mirror)
                        if mirror and not _is_remote(mirror) else mirror)
         self._copy = copy_fn or self._default_copy
+        self.mirror_errors = 0          # background replication failures
+        self.last_mirror_error: Optional[str] = None
+        self._on_mirror_error = on_mirror_error or _default_mirror_alarm
         self._mirror_lock = threading.Lock()
         self._mirror_kick = threading.Event()
         self._mirror_stop = threading.Event()
@@ -94,7 +117,7 @@ class CheckpointManager:
     def wait(self):
         self._mgr.wait_until_finished()
         if self.mirror is not None:
-            self.mirror_sync()
+            self._mirror_sync_guarded()
 
     def close(self):
         self._mirror_stop.set()
@@ -103,7 +126,7 @@ class CheckpointManager:
             self._mirror_thread.join(timeout=30)
         self._mgr.close()
         if self.mirror is not None:
-            self.mirror_sync()
+            self._mirror_sync_guarded()
 
     # ----------------------------------------------------------- mirror --
 
@@ -133,8 +156,25 @@ class CheckpointManager:
             try:
                 self._mgr.wait_until_finished()
                 self.mirror_sync()
-            except Exception:        # mirror must never kill the step loop
-                pass
+            except Exception as e:
+                self._record_mirror_error(e)
+
+    def _record_mirror_error(self, e: Exception) -> None:
+        """The mirror must never kill the (possibly finished) step loop,
+        but a dead mirror is exactly the failure to surface BEFORE the
+        slice dies: count it and raise the alarm."""
+        self.mirror_errors += 1
+        self.last_mirror_error = f"{type(e).__name__}: {e}"
+        try:
+            self._on_mirror_error(e)
+        except Exception:
+            pass
+
+    def _mirror_sync_guarded(self) -> None:
+        try:
+            self.mirror_sync()
+        except Exception as e:
+            self._record_mirror_error(e)
 
     def mirror_sync(self) -> list[int]:
         """Replicate every finished local step absent from the mirror.

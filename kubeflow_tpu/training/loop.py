@@ -53,6 +53,7 @@ def fit(
     max_steps: int,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 100,
+    checkpoint_mirror: Optional[str] = None,
     metrics: Optional[MetricsWriter] = None,
     metrics_every: int = 10,
     heartbeat: Optional[Heartbeat] = None,
@@ -79,7 +80,10 @@ def fit(
     resumed_from = None
     mgr = None
     if checkpoint_dir:
-        mgr = CheckpointManager(checkpoint_dir)
+        mgr = CheckpointManager(
+            checkpoint_dir,
+            mirror=checkpoint_mirror
+            or os.environ.get("KFT_CHECKPOINT_MIRROR") or None)
         latest = mgr.latest_step()
         if latest is not None:
             template = {"params": trainer.params,
@@ -124,6 +128,8 @@ def fit(
 
         last = {k: float(v) for k, v in m.items()
                 if hasattr(v, "__float__")}
+        if mgr is not None and mgr.mirror_errors:
+            last["ckpt_mirror_errors"] = float(mgr.mirror_errors)
         if metrics is not None and trainer.step % metrics_every == 0:
             metrics.write(trainer.step, **last)
         if heartbeat is not None:

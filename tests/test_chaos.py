@@ -49,7 +49,7 @@ def test_injector_scheduled_chaos_respects_max_kills():
     chaos = FaultInjector(cluster, seed=2)
     chaos.start("default", {"job-name": "bounded"},
                 period_s=0.02, max_kills=2)
-    deadline = time.time() + 10
+    deadline = time.time() + 30
     while time.time() < deadline and len(chaos.kills) < 2:
         time.sleep(0.05)
     time.sleep(0.2)
@@ -99,3 +99,61 @@ def test_real_job_survives_scheduled_chaos(tmp_path):
         chaos.stop()
         op.stop()
         cluster.shutdown()
+
+
+def test_dead_checkpoint_mirror_surfaces_warning_condition(
+        tmp_path, monkeypatch):
+    """Kill the checkpoint-mirror path (copy_fn always raises): the worker's
+    CheckpointManager must keep the step loop alive, count the failure, and
+    raise the alarm through the KFT_WARNING_FILE contract; the operator's
+    warning sweep must turn that into a job Warning condition + metric
+    WITHOUT disturbing the job's phase."""
+    from kubeflow_tpu.training.checkpoint import CheckpointManager
+
+    cluster = FakeCluster()
+    ctl = JobController(cluster)
+    op = Operator(ctl, heartbeat_dir=str(tmp_path / "hb"))
+    job = jax_job("mirror-job", workers=1, mesh={"data": 1})
+    op.submit(job)
+    ctl.reconcile("default", "mirror-job")
+    pods = cluster.list_pods("default", {"job-name": "mirror-job"})
+    assert pods, "reconcile created no pods"
+    pod = pods[0]
+    # operator injected the warning-file contract alongside the heartbeat
+    assert "KFT_WARNING_FILE" in pod.env
+    warn_path = pod.env["KFT_WARNING_FILE"]
+
+    # ---- worker side: mirror replication is dead --------------------
+    monkeypatch.setenv("KFT_WARNING_FILE", warn_path)
+
+    def broken_copy(src, dst):
+        raise OSError("mirror bucket unreachable")
+
+    mgr = CheckpointManager(
+        str(tmp_path / "local"), mirror=str(tmp_path / "mirror"),
+        async_save=False, copy_fn=broken_copy)
+    mgr.save(1, {"w": [1.0, 2.0]})          # kicks the mirror thread
+    deadline = time.time() + 30
+    while time.time() < deadline and mgr.mirror_errors == 0:
+        time.sleep(0.05)
+    assert mgr.mirror_errors >= 1
+    assert "mirror bucket unreachable" in mgr.last_mirror_error
+    # the step loop survived: a later save still works
+    assert mgr.save(2, {"w": [3.0, 4.0]})
+    mgr._mirror_stop.set()
+    mgr._mirror_kick.set()
+
+    # ---- controller side: sweep -> condition + metric ---------------
+    op._collect_warnings("default", "mirror-job")
+    out = ctl.get("default", "mirror-job")
+    warns = out.status.warnings()
+    assert warns and warns[0].reason == "CheckpointMirrorDegraded"
+    assert "mirror bucket unreachable" in warns[0].message
+    # advisory only: phase untouched, job not finished
+    assert out.status.condition() == ConditionType.CREATED
+    assert not out.status.is_finished()
+    assert op.metrics.get("kft_worker_warnings_total",
+                          {"reason": "CheckpointMirrorDegraded"}) == 1
+    # idempotent: a second sweep must not duplicate the condition
+    op._collect_warnings("default", "mirror-job")
+    assert len(ctl.get("default", "mirror-job").status.warnings()) == 1

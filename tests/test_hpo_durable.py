@@ -168,7 +168,7 @@ def test_serve_cli_smoke(tmp_path):
              "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", "")})
     try:
         line = ""
-        deadline = time.time() + 30
+        deadline = time.time() + 120
         while time.time() < deadline:
             line = proc.stdout.readline()
             if "serving on" in line:

@@ -198,7 +198,7 @@ def test_scale_from_zero_activator(gateway_op):
         t.start()
         # the kubelet role: once the ticker scales up and the controller
         # creates the pod, point it at the live backend and mark it running
-        deadline = time.time() + 30
+        deadline = time.time() + 60
         pod = None
         while time.time() < deadline and pod is None:
             pods = [p for p in cluster.pods.values()
@@ -229,3 +229,34 @@ def test_activator_only_engages_at_zero(gateway_op):
         urllib.request.urlopen(f"{base}/serving/default/m/v1/x")
     assert e.value.code == 503
     assert time.time() - t0 < 5.0                # fast, not a 60s hold
+
+
+def test_proxy_preserves_query_string(gateway_op):
+    """The data plane must forward query parameters (e.g. ?format=verbose
+    on a model-metadata GET) — the path join once dropped them."""
+    import json
+
+    class EchoPath(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"path": self.path}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), EchoPath)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    op, cluster, ctrl, base = gateway_op
+    try:
+        bind = f"127.0.0.1:{srv.server_address[1]}"
+        _isvc_with_revisions(cluster, ctrl, binds={1: bind}, traffic={1: 100})
+        out = json.loads(urllib.request.urlopen(
+            f"{base}/serving/default/m/v1/models/m?format=verbose&k=v"
+        ).read())
+        assert out["path"] == "/v1/models/m?format=verbose&k=v"
+    finally:
+        srv.shutdown()

@@ -84,7 +84,7 @@ def test_unattended_job_with_first_step_latency(operator, tmp_path):
     assert done.status.condition() == ConditionType.SUCCEEDED
 
     # heartbeat-derived latency metric
-    deadline = time.time() + 10
+    deadline = time.time() + 30
     latency = None
     while time.time() < deadline and latency is None:
         latency = operator.metrics.get(

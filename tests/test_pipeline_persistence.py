@@ -194,6 +194,39 @@ def test_ir_refuses_arbitrary_callables():
         pipeline_from_ir(bad)
 
 
+def test_ir_refuses_to_import_unlisted_modules(tmp_path, monkeypatch):
+    """fnRef must not trigger an import of an arbitrary module: importing
+    runs its top-level code, so the Component check alone comes too late.
+    Modules must be already-imported or under an allowed prefix."""
+    import sys
+
+    mod = tmp_path / "evil_component_host.py"
+    sentinel = tmp_path / "imported.flag"
+    mod.write_text(
+        f"open({str(sentinel)!r}, 'w').write('boom')\n"
+        "def f():\n    pass\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    assert "evil_component_host" not in sys.modules
+
+    ir = compile_pipeline(shard_scores)
+    bad = json.loads(json.dumps(ir))
+    key = next(iter(bad["components"]))
+    bad["components"][key]["fnRef"] = "evil_component_host:f"
+    with pytest.raises(ValueError, match="neither already imported"):
+        pipeline_from_ir(bad)
+    assert not sentinel.exists()        # refused BEFORE the import ran
+
+    # operators can whitelist their own component packages
+    from kubeflow_tpu.pipelines import compiler as compiler_mod
+
+    monkeypatch.setattr(compiler_mod, "_COMPONENT_MODULE_PREFIXES",
+                        {"kubeflow_tpu", "evil_component_host"})
+    with pytest.raises(ValueError, match="not a registered"):
+        pipeline_from_ir(bad)           # imports, then rejects non-Component
+    assert sentinel.exists()
+    sys.modules.pop("evil_component_host", None)
+
+
 def test_reupload_replaces_persisted_ir_and_schedule(tmp_path):
     """Re-uploading a pipeline/schedule under the same name must persist
     the NEW version (the store's contexts are get-or-create; the mutable
@@ -227,7 +260,7 @@ def test_failed_async_launch_is_visible(tmp_path):
     c = _client(tmp_path, "w1")
     c.upload_pipeline(needs_arg)
     run_id = c.create_run_async("needs-arg")   # missing required x
-    deadline = time.time() + 10
+    deadline = time.time() + 30
     run = None
     while time.time() < deadline:
         run = c.get_run(run_id)
@@ -337,7 +370,7 @@ def test_odd_pipeline_names_still_run(tmp_path):
     assert run.state == TaskState.SUCCEEDED
     assert "/" not in run.run_id and " " not in run.run_id
     rid = c.create_run_async("my pipeline (v2)")
-    deadline = time.time() + 15
+    deadline = time.time() + 60
     while time.time() < deadline:
         r = c.get_run(rid)
         if r is not None and r.state == TaskState.SUCCEEDED:

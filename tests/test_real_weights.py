@@ -249,6 +249,9 @@ def test_isvc_real_weights_text_e2e(tmp_path):
             storage_uri=f"file://{model_dir}",
             env={"KFT_DTYPE": "float32", "KFT_MAX_BATCH": "2",
                  "KFT_MAX_SEQ": "128", "JAX_PLATFORMS": "cpu",
+                 # JAX_PLATFORMS alone loses to a sitecustomize that
+                 # pre-registers a remote TPU platform; force via config
+                 "KFT_FORCE_PLATFORM": "cpu",
                  "KFT_MODEL_DIR": str(tmp_path / "mnt-models")}))
     try:
         ctrl.apply(isvc)
@@ -352,6 +355,11 @@ def test_multi_model_runtime_hot_loads(tmp_path):
     env = {**os.environ,
            "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", ""),
            "JAX_PLATFORMS": "cpu",
+           # JAX_PLATFORMS alone loses to a sitecustomize that registers a
+           # remote TPU platform — without the force the subprocess would
+           # contend for the (single-client) TPU tunnel and hot-loads
+           # become timing-flaky under full-suite load
+           "KFT_FORCE_PLATFORM": "cpu",
            "KFT_MODELS_CONFIG_DIR": str(cfg_dir),
            "KFT_MODEL_DIR": str(tmp_path / "mnt"),
            "KFT_DTYPE": "float32",
@@ -375,7 +383,9 @@ def test_multi_model_runtime_hot_loads(tmp_path):
             with urllib.request.urlopen(url + path, timeout=10) as r:
                 return json.loads(r.read())
 
-        deadline = time.time() + 120
+        # generous: each hot-load pays a cold XLA CPU compile, and the full
+        # suite can run under heavy CPU contention (this wait flaked at 120s)
+        deadline = time.time() + 360
         while time.time() < deadline:
             try:
                 idx = {m["name"] for m in get("/v2/repository/index")}
@@ -384,7 +394,8 @@ def test_multi_model_runtime_hot_loads(tmp_path):
             except Exception:
                 pass
             time.sleep(0.5)
-        assert {"alpha", "beta"} <= idx
+        assert {"alpha", "beta"} <= idx, (
+            f"hot-load incomplete after 360s: index={idx}")
 
         body = json.dumps({"instances": ["hi"],
                            "parameters": {"max_tokens": 3}}).encode()
@@ -396,7 +407,7 @@ def test_multi_model_runtime_hot_loads(tmp_path):
                 assert json.loads(r.read())["predictions"]
 
         (cfg_dir / "beta.json").unlink()          # hot unload
-        deadline = time.time() + 30
+        deadline = time.time() + 120
         while time.time() < deadline:
             idx = {m["name"] for m in get("/v2/repository/index")}
             if "beta" not in idx:

@@ -272,3 +272,40 @@ def test_dag_resolves_pipeline_by_metadata_not_prefix(tmp_path):
     custom = client.create_run("train-v2", run_id="myrun")
     detail = get(ui, "/ui/pipelines/runs/myrun")
     assert "marker-end" in detail
+
+
+def test_cross_site_form_posts_rejected(tmp_path):
+    """CSRF guard: a browser's cross-origin form POST (Sec-Fetch-Site:
+    cross-site / mismatched Origin) is rejected before any mutation;
+    same-origin posts and header-less tools still work."""
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+    ui = WebUI(jobs=jobs, notebooks=NotebookController(cluster))
+    op = Operator(jobs, reconcile_period=0.05, webui=ui)
+    port = op.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        def post(path, headers):
+            req = urllib.request.Request(
+                f"{base}{path}", method="POST", data=b"name=nb")
+            for k, v in headers.items():
+                req.add_header(k, v)
+            return urllib.request.urlopen(req)
+
+        for evil in ({"Sec-Fetch-Site": "cross-site"},
+                     {"Origin": "http://evil.example"},
+                     {"Origin": "null"}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post("/ui/notebooks/default/create", evil)
+            assert e.value.code == 403, evil
+        assert ("default", "nb") not in ui.notebooks.notebooks
+
+        # same-origin browser post passes
+        resp = post("/ui/notebooks/default/create",
+                    {"Sec-Fetch-Site": "same-origin",
+                     "Origin": f"http://127.0.0.1:{port}",
+                     "Host": f"127.0.0.1:{port}"})
+        assert resp.status == 200
+        assert ("default", "nb") in ui.notebooks.notebooks
+    finally:
+        op.stop()
