@@ -9,13 +9,21 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test native native-asan test-native-asan dryrun clean
+.PHONY: ci test test-kube native native-asan test-native-asan dryrun clean
 
-ci: test-native-asan test dryrun
+ci: test-native-asan test test-kube dryrun
 	@echo "CI OK"
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# the controller/gang suites again, UNCHANGED, over KubeCluster + the fake
+# apiserver (SURVEY.md §4.2 envtest role): proves the reconciler drives the
+# Kubernetes REST API, not just in-memory fakes
+test-kube:
+	KFT_TEST_CLUSTER=kube $(PY) -m pytest \
+		tests/test_controller.py tests/test_gang.py \
+		tests/test_kube_cluster.py -x -q
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -402,6 +402,20 @@ def to_yaml(job: JobSpec) -> str:
     }
     if job.elastic is not None:
         doc["spec"]["elasticPolicy"] = _to_plain(job.elastic)
+    if job.uid:
+        doc["metadata"]["uid"] = job.uid
+    cond = job.status.condition()
+    if cond is not None:
+        # CR status subresource role: enough state that a restarted
+        # controller never re-runs a finished job, never loses its
+        # active-deadline/TTL clocks, and keeps its backoff count
+        # (replica counts are recomputed from live pod observation)
+        doc["status"] = {
+            "condition": cond.value,
+            "restartCount": job.status.restart_count,
+            "startTime": job.status.start_time,
+            "completionTime": job.status.completion_time,
+        }
     return yaml.safe_dump(doc, sort_keys=False)
 
 
@@ -463,7 +477,7 @@ def from_yaml(text: str) -> JobSpec:
             rdzv_backend=_g("rdzv_backend", "rdzvBackend", "c10d"),
             max_restarts=_g("max_restarts", "maxRestarts", 3),
         )
-    return JobSpec(
+    job = JobSpec(
         name=meta.get("name", "job"),
         namespace=meta.get("namespace", "default"),
         kind=doc.get("kind", "JAXJob"),
@@ -471,4 +485,15 @@ def from_yaml(text: str) -> JobSpec:
         run_policy=run_policy,
         labels=meta.get("labels", {}),
         elastic=elastic,
+        uid=meta.get("uid", ""),
     )
+    st = doc.get("status") or {}
+    if st.get("condition"):
+        job.status.conditions.append(Condition(
+            type=ConditionType(st["condition"]), reason="Restored"))
+        job.status.restart_count = int(st.get("restartCount", 0))
+        if st.get("startTime") is not None:
+            job.status.start_time = float(st["startTime"])
+        if st.get("completionTime") is not None:
+            job.status.completion_time = float(st["completionTime"])
+    return job

@@ -24,8 +24,16 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--bind-host", default="127.0.0.1",
                        help="API bind address; in-cluster Deployments pass "
                             "0.0.0.0 so probes/Services can reach it")
-    serve.add_argument("--cluster", choices=("local", "fake"), default="local",
-                       help="pod backend: local subprocesses or in-memory")
+    serve.add_argument("--cluster", choices=("local", "fake", "kube"),
+                       default="local",
+                       help="pod backend: local subprocesses, in-memory, "
+                            "or a Kubernetes apiserver (--apiserver)")
+    serve.add_argument("--apiserver", default=None,
+                       help="Kubernetes apiserver URL for --cluster kube; "
+                            "defaults to the in-cluster env "
+                            "(KUBERNETES_SERVICE_HOST) when unset")
+    serve.add_argument("--kube-image", default="kubeflow-tpu/runtime:latest",
+                       help="default worker image for --cluster kube pods")
     serve.add_argument("--config", default=None,
                        help="platform config JSON (the ConfigMap tier); "
                             "flags below override it")
@@ -69,9 +77,32 @@ def main(argv=None) -> int:
         "state_dir": args.state_dir,
     })
 
-    cluster = (LocalProcessCluster(log_dir=cfg.log_dir)
-               if args.cluster == "local" else FakeCluster())
-    controller = JobController(cluster)
+    if args.cluster == "kube":
+        import os as _os
+
+        from kubeflow_tpu.controller.kube import JobCRStore, KubeCluster
+
+        url = args.apiserver
+        if url is None:
+            host = _os.environ.get("KUBERNETES_SERVICE_HOST")
+            if not host:
+                raise SystemExit(
+                    "--cluster kube needs --apiserver or the in-cluster "
+                    "KUBERNETES_SERVICE_HOST env")
+            url = (f"https://{host}:"
+                   f"{_os.environ.get('KUBERNETES_SERVICE_PORT', '443')}")
+        cluster = KubeCluster(url, image=args.kube_image)
+        controller = JobController(cluster)
+        # jobs live as CRs in the apiserver (the etcd role): a restarted
+        # controller reloads them and adopts its existing pods (uid
+        # round-trips, so the job-uid pod selector still matches)
+        controller.job_store = JobCRStore(cluster)
+        for job in controller.job_store.load_all():
+            controller.restore(job)
+    else:
+        cluster = (LocalProcessCluster(log_dir=cfg.log_dir)
+                   if args.cluster == "local" else FakeCluster())
+        controller = JobController(cluster)
     controller.scheduler.aging_s = cfg.gang_aging_s
 
     # the whole platform in one daemon: training jobs + HPO experiments

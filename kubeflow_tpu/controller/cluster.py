@@ -50,6 +50,12 @@ class Pod:
     node: Optional[str] = None
     scheduled: bool = False            # gang admission happened
     created_at: float = dataclasses.field(default_factory=time.time)
+    # real-cluster placement (rendered by the KubeCluster backend; ignored
+    # by in-memory/local-process backends): container image, GKE TPU
+    # topology nodeSelector, and resource limits (google.com/tpu etc.)
+    image: str = ""
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    resources: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,331 @@
+"""A minimal in-process Kubernetes apiserver — the envtest role.
+
+The reference tests its controllers against envtest: a real apiserver+etcd
+with no kubelets, where "pods are created but never run" and tests drive
+phases by patching status (SURVEY.md §4.2). This module is that harness for
+the `KubeCluster` backend: an HTTP server speaking the minimal apiserver
+subset the controllers use —
+
+- typed + generic object storage for core (``/api/v1``) and group
+  (``/apis/{group}/{version}``) resources, namespaced or cluster-scoped;
+- POST (409 on exists), GET, PUT, JSON-merge PATCH, DELETE;
+- list with ``labelSelector=k=v,k2=v2``;
+- the ``/status`` subresource (how tests play the kubelet);
+- ``?watch=true`` chunked streaming of ADDED/MODIFIED/DELETED events with
+  ``resourceVersion`` resume (how the informer cache stays fresh).
+
+It is intentionally NOT a validation-complete apiserver: schema checking,
+admission chains, and RBAC live in this repo's own webhook/auth layers
+(SURVEY.md §2.1, §2.6); what matters here is wire-level parity for the
+client in `controller/kube.py`, so the same client drives a real apiserver
+unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def _merge(dst: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch."""
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def _match_selector(obj: dict, selector: str) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    for clause in selector.split(","):
+        if not clause:
+            continue
+        if "!=" in clause:
+            k, v = clause.split("!=", 1)
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "=" in clause:
+            k, v = clause.replace("==", "=").split("=", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        else:                       # bare key: existence
+            if clause.strip() not in labels:
+                return False
+    return True
+
+
+class _Store:
+    """Versioned object store + event log for watches."""
+
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.rv = 0
+        # (resource path prefix, namespace or "", name) -> object dict
+        self.objects: dict[tuple[str, str, str], dict] = {}
+        # append-only: (rv, type, resource, namespace, object snapshot)
+        self.events: list[tuple[int, str, str, str, dict]] = []
+
+    def bump(self) -> int:
+        self.rv += 1
+        return self.rv
+
+    def record(self, etype: str, resource: str, ns: str, obj: dict):
+        self.events.append(
+            (self.rv, etype, resource, ns, json.loads(json.dumps(obj))))
+        if len(self.events) > 10000:        # bounded history
+            del self.events[:5000]
+        self.lock.notify_all()
+
+
+class FakeKubeApiServer:
+    """`start()` binds an ephemeral port; `url` is the apiserver base."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self.store = _Store()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # ------------------------------------------------------------ http --
+
+    def start(self) -> "FakeKubeApiServer":
+        store = self.store
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):      # quiet
+                pass
+
+            # -- plumbing --------------------------------------------
+
+            def _send_json(self, code: int, obj: dict):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _err(self, code: int, reason: str, message: str):
+                self._send_json(code, {
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Failure", "reason": reason,
+                    "message": message, "code": code})
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                return json.loads(raw) if raw else {}
+
+            def _route(self):
+                """Parse an apiserver path into
+                (resource_prefix, namespace, name, subresource)."""
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                # /api/v1/... or /apis/{group}/{version}/...
+                if parts[:2] == ["api", "v1"]:
+                    rest, prefix = parts[2:], "api/v1"
+                elif parts[:1] == ["apis"] and len(parts) >= 3:
+                    rest, prefix = parts[3:], "/".join(parts[:3])
+                else:
+                    return None
+                ns = ""
+                if rest[:1] == ["namespaces"] and len(rest) >= 3:
+                    ns, rest = rest[1], rest[2:]
+                elif rest[:1] == ["namespaces"] and len(rest) == 2:
+                    # namespace object itself: /api/v1/namespaces/{name}
+                    return (f"{prefix}/namespaces", "", rest[1], "", q)
+                if not rest:
+                    return None
+                resource = f"{prefix}/{rest[0]}"
+                name = rest[1] if len(rest) > 1 else ""
+                sub = rest[2] if len(rest) > 2 else ""
+                return (resource, ns, name, sub, q)
+
+            # -- verbs -----------------------------------------------
+
+            def do_GET(self):
+                r = self._route()
+                if r is None:
+                    return self._err(404, "NotFound", self.path)
+                resource, ns, name, _sub, q = r
+                with store.lock:
+                    if name:
+                        obj = store.objects.get((resource, ns, name))
+                        if obj is None:
+                            return self._err(404, "NotFound",
+                                             f"{resource} {ns}/{name}")
+                        return self._send_json(200, obj)
+                    items = [o for (res, ons, _), o in
+                             sorted(store.objects.items())
+                             if res == resource and (not ns or ons == ns)
+                             and _match_selector(
+                                 o, q.get("labelSelector", ""))]
+                    rv = store.rv
+                if q.get("watch") == "true":
+                    return self._watch(resource, ns,
+                                       q.get("labelSelector", ""),
+                                       int(q.get("resourceVersion", rv)),
+                                       float(q.get("timeoutSeconds", 30)))
+                self._send_json(200, {
+                    "kind": "List", "apiVersion": "v1",
+                    "metadata": {"resourceVersion": str(rv)},
+                    "items": items})
+
+            def _watch(self, resource, ns, selector, from_rv, timeout):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(etype, obj):
+                    line = json.dumps(
+                        {"type": etype, "object": obj}).encode() + b"\n"
+                    self.wfile.write(
+                        f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+
+                import time as _t
+                end = _t.monotonic() + timeout
+                last = from_rv
+                try:
+                    while _t.monotonic() < end:
+                        with store.lock:
+                            pending = [
+                                e for e in store.events
+                                if e[0] > last and e[2] == resource
+                                and (not ns or e[3] == ns)
+                                and _match_selector(e[4], selector)]
+                            if not pending:
+                                store.lock.wait(
+                                    min(1.0, end - _t.monotonic()))
+                                pending = [
+                                    e for e in store.events
+                                    if e[0] > last and e[2] == resource
+                                    and (not ns or e[3] == ns)
+                                    and _match_selector(e[4], selector)]
+                            if pending:
+                                last = max(e[0] for e in pending)
+                        for _, etype, _, _, obj in pending:
+                            emit(etype, obj)
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                self.close_connection = True
+
+            def do_POST(self):
+                r = self._route()
+                if r is None:
+                    return self._err(404, "NotFound", self.path)
+                resource, ns, _name, _sub, _q = r
+                obj = self._body()
+                name = obj.get("metadata", {}).get("name", "")
+                if not name:
+                    return self._err(422, "Invalid", "metadata.name required")
+                key = (resource, ns, name)
+                with store.lock:
+                    if key in store.objects:
+                        return self._err(
+                            409, "AlreadyExists", f"{resource} {name}")
+                    obj.setdefault("metadata", {})
+                    obj["metadata"]["namespace"] = ns or None
+                    obj["metadata"]["resourceVersion"] = str(store.bump())
+                    obj.setdefault("status", {})
+                    if resource.endswith("/pods"):
+                        obj["status"].setdefault("phase", "Pending")
+                    store.objects[key] = obj
+                    store.record("ADDED", resource, ns, obj)
+                self._send_json(201, obj)
+
+            def do_PUT(self):
+                r = self._route()
+                if r is None or not r[2]:
+                    return self._err(404, "NotFound", self.path)
+                resource, ns, name, _sub, _q = r
+                obj = self._body()
+                key = (resource, ns, name)
+                with store.lock:
+                    if key not in store.objects:
+                        return self._err(404, "NotFound", name)
+                    obj.setdefault("metadata", {})
+                    obj["metadata"]["namespace"] = ns or None
+                    obj["metadata"]["resourceVersion"] = str(store.bump())
+                    store.objects[key] = obj
+                    store.record("MODIFIED", resource, ns, obj)
+                self._send_json(200, obj)
+
+            def do_PATCH(self):
+                r = self._route()
+                if r is None or not r[2]:
+                    return self._err(404, "NotFound", self.path)
+                resource, ns, name, sub, _q = r
+                patch = self._body()
+                key = (resource, ns, name)
+                with store.lock:
+                    obj = store.objects.get(key)
+                    if obj is None:
+                        return self._err(404, "NotFound", name)
+                    if sub == "status":
+                        _merge(obj.setdefault("status", {}),
+                               patch.get("status", patch))
+                    else:
+                        _merge(obj, patch)
+                    obj["metadata"]["resourceVersion"] = str(store.bump())
+                    store.record("MODIFIED", resource, ns, obj)
+                self._send_json(200, obj)
+
+            def do_DELETE(self):
+                r = self._route()
+                if r is None or not r[2]:
+                    return self._err(404, "NotFound", self.path)
+                resource, ns, name, _sub, _q = r
+                key = (resource, ns, name)
+                with store.lock:
+                    obj = store.objects.pop(key, None)
+                    if obj is None:
+                        return self._err(404, "NotFound", name)
+                    store.bump()
+                    store.record("DELETED", resource, ns, obj)
+                self._send_json(200, {"kind": "Status", "status": "Success"})
+
+        self._httpd = ThreadingHTTPServer((self.host, 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fake-apiserver")
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # ------------------------------------------------------ inspection --
+
+    def get(self, resource: str, namespace: str, name: str) -> Optional[dict]:
+        with self.store.lock:
+            obj = self.store.objects.get((resource, namespace, name))
+            return json.loads(json.dumps(obj)) if obj else None
+
+    def count(self, resource: str) -> int:
+        with self.store.lock:
+            return sum(1 for (res, _, _) in self.store.objects
+                       if res == resource)

@@ -1,0 +1,653 @@
+"""`KubeCluster` — the Cluster backend that speaks to a real Kubernetes
+apiserver over its REST API.
+
+Parity: the reference's controllers ARE Kubernetes clients — client-go
+informers + a workqueue reconciling Pods/Services ([U] training-operator:
+pkg/controller.v1/common/{job.go,pod.go,service.go}; SURVEY.md §3.1). This
+module plays that role for the `JobController`: the SAME reconcile logic
+that drives FakeCluster/LocalProcessCluster drives a live apiserver through
+this class, and the envtest-equivalent harness
+(`controller/fake_apiserver.py`) proves it without a cluster.
+
+Design (informer-cache, not request-per-read):
+
+- The controller reads and MUTATES `Pod` dataclasses (env late-binding,
+  `scheduled` flags, heartbeat-declared failures). KubeCluster keeps one
+  dataclass per live pod — the informer-cache role — and `sync()`s status
+  from the apiserver on reads, while local *writes* flow back explicitly:
+  `create_pod` POSTs the manifest, `start_pod` (gang admission) PATCHes
+  away the scheduling gate and publishes late-bound env as annotations.
+- Gang admission maps to **pod scheduling gates**: pods are created with
+  `schedulingGates: [{name: "kubeflow-tpu.org/gang"}]`, so a real
+  kube-scheduler cannot place any member early; `start_pod` lifts the gate
+  once the whole slice group is admitted. This is the K8s-native form of
+  the whole-slice atom (SURVEY.md §2.1 gang glue).
+- Phase merging is **terminal-wins**: once a pod is terminal locally (a
+  heartbeat-declared failure) or remotely (kubelet truth), later syncs
+  never resurrect it — mirrors pod-phase monotonicity.
+- Late-bound values (e.g. KFT_SLICE_ID, decided at admission, after pod
+  creation) cannot be env on an immutable pod spec; they publish as
+  `kubeflow-tpu.org/env.<KEY>` annotations, surfaced in-container via a
+  downward-API `podinfo` volume (`rendezvous.bootstrap` reads both).
+
+No kubernetes client library: auth is a bearer token (+ CA bundle for
+https), exactly what a ServiceAccount mount provides in-cluster.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import ssl
+import threading
+from typing import Iterator, Optional
+from urllib.parse import quote, urlparse
+
+from kubeflow_tpu.controller.cluster import Pod, PodPhase, Service
+
+GANG_GATE = "kubeflow-tpu.org/gang"
+ENV_ANNOTATION_PREFIX = "kubeflow-tpu.org/env."
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+_PHASES = {
+    "Pending": PodPhase.PENDING,
+    "Running": PodPhase.RUNNING,
+    "Succeeded": PodPhase.SUCCEEDED,
+    "Failed": PodPhase.FAILED,
+}
+_TERMINAL = (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+# apiVersion/kind -> (path prefix, plural) for generic apply()
+_KIND_PATHS = {
+    ("v1", "Pod"): "pods",
+    ("v1", "Service"): "services",
+    ("v1", "Namespace"): "namespaces",
+    ("v1", "ConfigMap"): "configmaps",
+    ("v1", "ServiceAccount"): "serviceaccounts",
+    ("v1", "PersistentVolumeClaim"): "persistentvolumeclaims",
+    ("apps/v1", "Deployment"): "deployments",
+    ("rbac.authorization.k8s.io/v1", "ClusterRole"): "clusterroles",
+    ("rbac.authorization.k8s.io/v1", "ClusterRoleBinding"):
+        "clusterrolebindings",
+    ("networking.k8s.io/v1", "NetworkPolicy"): "networkpolicies",
+    ("apiextensions.k8s.io/v1", "CustomResourceDefinition"):
+        "customresourcedefinitions",
+}
+_CLUSTER_SCOPED = {"Namespace", "ClusterRole", "ClusterRoleBinding",
+                   "CustomResourceDefinition"}
+
+
+class KubeApiError(RuntimeError):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"apiserver {code}: {message}")
+        self.code = code
+
+
+def pod_to_manifest(pod: Pod, image: str) -> dict:
+    """Render the repo's Pod dataclass as a v1 Pod manifest. TPU placement
+    travels as the GKE topology nodeSelector + google.com/tpu resource
+    (BASELINE.md scheduling contract; platform/manifests.py is the shared
+    convention)."""
+    container = {
+        "name": "worker",
+        "image": pod.image or image,
+        "env": [{"name": k, "value": str(v)}
+                for k, v in sorted(pod.env.items())],
+        "volumeMounts": [{"name": "podinfo", "mountPath": "/etc/podinfo"}],
+    }
+    if pod.resources:
+        container["resources"] = {"limits": dict(pod.resources),
+                                  "requests": dict(pod.resources)}
+    if pod.command:
+        container["command"] = list(pod.command)
+    spec = {
+        "restartPolicy": "Never",      # restarts are the controller's call
+        "schedulingGates": [{"name": GANG_GATE}],
+        "containers": [container],
+        # late-bound admission values surface in-container through the
+        # downward API (annotations stay mutable; pod env does not)
+        "volumes": [{"name": "podinfo", "downwardAPI": {"items": [
+            {"path": "annotations",
+             "fieldRef": {"fieldPath": "metadata.annotations"}}]}}],
+    }
+    if pod.node_selector:
+        spec["nodeSelector"] = dict(pod.node_selector)
+    if pod.init_command:
+        spec["initContainers"] = [{
+            "name": "storage-initializer",
+            "image": pod.image or image,
+            "command": list(pod.init_command),
+            "env": container["env"],
+        }]
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": pod.name, "namespace": pod.namespace,
+            "labels": dict(pod.labels),
+            "annotations": {},
+        },
+        "spec": spec,
+    }
+
+
+def _manifest_status(doc: dict) -> tuple[PodPhase, Optional[int]]:
+    status = doc.get("status", {}) or {}
+    phase = _PHASES.get(status.get("phase", "Pending"), PodPhase.PENDING)
+    exit_code = None
+    for cs in status.get("containerStatuses", []) or []:
+        term = (cs.get("state", {}) or {}).get("terminated")
+        if term is not None and term.get("exitCode") is not None:
+            exit_code = int(term["exitCode"])
+    if exit_code is None and status.get("exitCode") is not None:
+        exit_code = int(status["exitCode"])
+    return phase, exit_code
+
+
+class KubeCluster:
+    """Cluster protocol over the Kubernetes REST API.
+
+    ``base_url``: apiserver endpoint (e.g. https://10.0.0.1:443 or the
+    fake apiserver's http URL). ``token``/``ca_file`` default to the
+    in-cluster ServiceAccount mount when present.
+    """
+
+    def __init__(self, base_url: str, *, token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 insecure_skip_verify: bool = False,
+                 image: str = "kubeflow-tpu/runtime:latest",
+                 request_timeout: float = 30.0):
+        u = urlparse(base_url)
+        self.scheme = u.scheme or "http"
+        self.host = u.hostname
+        self.port = u.port or (443 if self.scheme == "https" else 80)
+        self.image = image
+        self.timeout = request_timeout
+        if token is None and os.path.exists(f"{_SA_DIR}/token"):
+            with open(f"{_SA_DIR}/token") as f:
+                token = f.read().strip()
+        if ca_file is None and os.path.exists(f"{_SA_DIR}/ca.crt"):
+            ca_file = f"{_SA_DIR}/ca.crt"
+        self.token = token
+        self._ssl = None
+        if self.scheme == "https":
+            self._ssl = ssl.create_default_context(cafile=ca_file)
+            if insecure_skip_verify:
+                self._ssl.check_hostname = False
+                self._ssl.verify_mode = ssl.CERT_NONE
+        self._lock = threading.RLock()
+        self._pods: dict[tuple[str, str], Pod] = {}     # informer cache
+        self._gated: set[tuple[str, str]] = set()       # gate still set
+        self._pushed_env: dict[tuple[str, str], dict] = {}
+        self._services: dict[tuple[str, str], Service] = {}
+        self._informer: Optional[threading.Thread] = None
+        self._informer_stop = threading.Event()
+
+    # ------------------------------------------------------------ http --
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json") -> dict:
+        if self.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout,
+                context=self._ssl)
+        else:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        try:
+            headers = {"Accept": "application/json"}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            data = None
+            if body is not None:
+                data = json.dumps(body).encode()
+                headers["Content-Type"] = content_type
+            conn.request(method, path, body=data, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status == 404:
+                raise KubeApiError(404, path)
+            if resp.status >= 400:
+                try:
+                    msg = json.loads(raw).get("message", raw.decode())
+                except Exception:
+                    msg = raw.decode(errors="replace")
+                raise KubeApiError(resp.status, msg)
+            return json.loads(raw) if raw else {}
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _pod_path(ns: str, name: str = "", sub: str = "") -> str:
+        p = f"/api/v1/namespaces/{quote(ns)}/pods"
+        if name:
+            p += f"/{quote(name)}"
+        if sub:
+            p += f"/{sub}"
+        return p
+
+    # ------------------------------------------------------ pod verbs --
+
+    def create_pod(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        manifest = pod_to_manifest(pod, self.image)
+        try:
+            self._request("POST", self._pod_path(pod.namespace),
+                          manifest)
+        except KubeApiError as e:
+            if e.code == 409:
+                raise KeyError(f"pod {key} exists") from e
+            raise
+        with self._lock:
+            self._pods[key] = pod
+            self._gated.add(key)
+            self._pushed_env[key] = dict(pod.env)
+
+    def start_pod(self, pod: Pod) -> None:
+        """Gang admission: lift the scheduling gate so the scheduler may
+        place the pod, and publish late-bound env as annotations."""
+        key = (pod.namespace, pod.name)
+        patch: dict = {}
+        with self._lock:
+            if key in self._gated:
+                patch["spec"] = {"schedulingGates": []}
+                self._gated.discard(key)
+            extra = {k: v for k, v in pod.env.items()
+                     if self._pushed_env.get(key, {}).get(k) != v}
+            if extra:
+                patch.setdefault("metadata", {})["annotations"] = {
+                    ENV_ANNOTATION_PREFIX + k: str(v)
+                    for k, v in extra.items()}
+                self._pushed_env.setdefault(key, {}).update(extra)
+        if patch:
+            self._request(
+                "PATCH", self._pod_path(pod.namespace, pod.name), patch,
+                content_type="application/merge-patch+json")
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        try:
+            self._request(
+                "DELETE",
+                self._pod_path(namespace, name) + "?gracePeriodSeconds=0")
+        except KubeApiError as e:
+            if e.code != 404:
+                raise
+        with self._lock:
+            self._pods.pop(key, None)
+            self._gated.discard(key)
+            self._pushed_env.pop(key, None)
+
+    def _apply_remote(self, pod: Pod, doc: dict) -> None:
+        phase, exit_code = _manifest_status(doc)
+        gates = (doc.get("spec", {}) or {}).get("schedulingGates") or []
+        if not gates:
+            # another controller replica (or this one, earlier) lifted it
+            self._gated.discard((pod.namespace, pod.name))
+        else:
+            # still gated server-side — crucial for pods ADOPTED after a
+            # controller restart: start_pod must know to lift the gate
+            self._gated.add((pod.namespace, pod.name))
+        if pod.phase in _TERMINAL:
+            return                      # terminal-wins: never resurrect
+        pod.phase = phase
+        if exit_code is not None:
+            pod.exit_code = exit_code
+        node = (doc.get("spec", {}) or {}).get("nodeName")
+        if node:
+            pod.node = node
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        key = (namespace, name)
+        try:
+            doc = self._request("GET", self._pod_path(namespace, name))
+        except KubeApiError as e:
+            if e.code == 404:
+                with self._lock:
+                    self._pods.pop(key, None)
+                return None
+            raise
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None:
+                pod = self._pod_from_manifest(doc)
+                self._pods[key] = pod
+            self._apply_remote(pod, doc)
+            return pod
+
+    def list_pods(self, namespace: str,
+                  selector: dict[str, str]) -> list[Pod]:
+        sel = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+        path = self._pod_path(namespace)
+        if sel:
+            path += f"?labelSelector={quote(sel)}"
+        docs = self._request("GET", path).get("items", [])
+        out = []
+        with self._lock:
+            remote = set()
+            for doc in docs:
+                name = doc["metadata"]["name"]
+                key = (namespace, name)
+                remote.add(key)
+                pod = self._pods.get(key)
+                if pod is None:
+                    pod = self._pod_from_manifest(doc)
+                    self._pods[key] = pod
+                self._apply_remote(pod, doc)
+                out.append(pod)
+            # reap cache entries whose pods vanished server-side
+            for key in [k for k, p in self._pods.items()
+                        if k[0] == namespace and k not in remote
+                        and all(p.labels.get(lk) == lv
+                                for lk, lv in selector.items())]:
+                self._pods.pop(key, None)
+        return out
+
+    def _pod_from_manifest(self, doc: dict) -> Pod:
+        meta = doc.get("metadata", {})
+        spec = doc.get("spec", {}) or {}
+        containers = spec.get("containers") or [{}]
+        env = {e["name"]: e.get("value", "")
+               for e in containers[0].get("env", []) or []}
+        for k, v in (meta.get("annotations") or {}).items():
+            if k.startswith(ENV_ANNOTATION_PREFIX):
+                env.setdefault(k[len(ENV_ANNOTATION_PREFIX):], v)
+        pod = Pod(
+            name=meta["name"], namespace=meta.get("namespace") or "default",
+            labels=dict(meta.get("labels") or {}),
+            env=env,
+            command=list(containers[0].get("command") or []),
+            init_command=list(
+                (spec.get("initContainers") or [{}])[0].get("command")
+                or []),
+        )
+        pod.scheduled = not spec.get("schedulingGates")
+        # adoption bookkeeping: what the server already has needs no push
+        self._pushed_env[(pod.namespace, pod.name)] = dict(env)
+        return pod
+
+    # -------------------------------------------------- service verbs --
+
+    def create_service(self, svc: Service) -> None:
+        manifest = {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": svc.name, "namespace": svc.namespace},
+            "spec": {
+                "clusterIP": "None",       # headless: per-pod DNS
+                "selector": dict(svc.selector),
+                "ports": [{"port": svc.port}],
+            },
+        }
+        try:
+            self._request(
+                "POST",
+                f"/api/v1/namespaces/{quote(svc.namespace)}/services",
+                manifest)
+        except KubeApiError as e:
+            if e.code != 409:
+                raise
+        with self._lock:
+            self._services[(svc.namespace, svc.name)] = svc
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        try:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{quote(namespace)}/services/"
+                f"{quote(name)}")
+        except KubeApiError as e:
+            if e.code != 404:
+                raise
+        with self._lock:
+            self._services.pop((namespace, name), None)
+
+    def get_service(self, namespace: str, name: str) -> Optional[Service]:
+        with self._lock:
+            svc = self._services.get((namespace, name))
+        if svc is not None:
+            return svc
+        try:
+            doc = self._request(
+                "GET",
+                f"/api/v1/namespaces/{quote(namespace)}/services/"
+                f"{quote(name)}")
+        except KubeApiError as e:
+            if e.code == 404:
+                return None
+            raise
+        spec = doc.get("spec", {}) or {}
+        svc = Service(
+            name=name, namespace=namespace,
+            selector=dict(spec.get("selector") or {}),
+            port=int((spec.get("ports") or [{"port": 0}])[0]["port"]))
+        with self._lock:
+            self._services[(namespace, name)] = svc
+        return svc
+
+    def resolve(self, namespace: str, service: str) -> str:
+        """Cluster-DNS convention — resolvable from any pod in-cluster."""
+        svc = self.get_service(namespace, service)
+        port = svc.port if svc else 0
+        return f"{service}.{namespace}.svc:{port}"
+
+    # ------------------------------------------------------- watching --
+
+    def watch_pods(self, namespace: str, selector: dict[str, str] = {},
+                   timeout_s: float = 30.0,
+                   from_rv: int = 0) -> Iterator[tuple[str, Pod]]:
+        """Stream (event_type, Pod) from the apiserver watch endpoint —
+        the informer feed. Yields until the server closes the window.
+        ``from_rv=0`` replays retained history, so a watch opened after an
+        event still observes it (the list+watch resume semantics)."""
+        sel = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+        path = (self._pod_path(namespace)
+                + f"?watch=true&timeoutSeconds={int(timeout_s)}"
+                + f"&resourceVersion={int(from_rv)}")
+        if sel:
+            path += f"&labelSelector={quote(sel)}"
+        if self.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout_s + 10,
+                context=self._ssl)
+        else:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=timeout_s + 10)
+        try:
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            conn.request("GET", path, headers=headers)
+            resp = conn.getresponse()
+            buf = b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    event = json.loads(line)
+                    doc = event["object"]
+                    try:
+                        self._watch_rv = max(
+                            getattr(self, "_watch_rv", 0),
+                            int(doc["metadata"].get("resourceVersion", 0)))
+                    except (TypeError, ValueError):
+                        pass
+                    key = (doc["metadata"].get("namespace") or "default",
+                           doc["metadata"]["name"])
+                    with self._lock:
+                        pod = self._pods.get(key)
+                        if event["type"] == "DELETED":
+                            self._pods.pop(key, None)
+                            if pod is None:
+                                pod = self._pod_from_manifest(doc)
+                        else:
+                            if pod is None:
+                                pod = self._pod_from_manifest(doc)
+                                self._pods[key] = pod
+                            self._apply_remote(pod, doc)
+                    yield event["type"], pod
+        finally:
+            conn.close()
+
+    def start_informer(self, namespace: str,
+                       selector: dict[str, str] = {}) -> None:
+        """Background watch keeping the cache fresh between reconciles."""
+        if self._informer is not None:
+            return
+
+        def loop():
+            while not self._informer_stop.is_set():
+                try:
+                    for _ in self.watch_pods(
+                            namespace, selector, timeout_s=10,
+                            from_rv=getattr(self, "_watch_rv", 0)):
+                        if self._informer_stop.is_set():
+                            return
+                except Exception:
+                    if self._informer_stop.wait(1.0):
+                        return
+
+        self._informer = threading.Thread(
+            target=loop, daemon=True, name="kube-informer")
+        self._informer.start()
+
+    def stop_informer(self) -> None:
+        self._informer_stop.set()
+        if self._informer is not None:
+            self._informer.join(timeout=15)
+            self._informer = None
+        self._informer_stop.clear()
+
+    # ------------------------------------------------ generic install --
+
+    def apply(self, doc: dict) -> dict:
+        """kubectl-apply role: POST, falling back to PUT on conflict.
+        Routes by apiVersion/kind (platform/manifests.py output)."""
+        api, kind = doc.get("apiVersion", "v1"), doc.get("kind", "")
+        plural = _KIND_PATHS.get((api, kind))
+        if plural is None:
+            plural = kind.lower() + "s"       # CRD convention
+        prefix = "/api/v1" if api == "v1" else f"/apis/{api}"
+        name = doc.get("metadata", {}).get("name", "")
+        if kind in _CLUSTER_SCOPED:
+            base = f"{prefix}/{plural}"
+        else:
+            ns = doc.get("metadata", {}).get("namespace") or "default"
+            base = f"{prefix}/namespaces/{quote(ns)}/{plural}"
+        try:
+            return self._request("POST", base, doc)
+        except KubeApiError as e:
+            if e.code != 409:
+                raise
+            return self._request("PUT", f"{base}/{quote(name)}", doc)
+
+    # ------------------------------------------------------- CR verbs --
+
+    def save_cr(self, group: str, version: str, plural: str,
+                namespace: str, name: str, doc: dict) -> None:
+        base = f"/apis/{group}/{version}/namespaces/{quote(namespace)}/" \
+               f"{plural}"
+        try:
+            self._request("POST", base, doc)
+        except KubeApiError as e:
+            if e.code != 409:
+                raise
+            self._request("PUT", f"{base}/{quote(name)}", doc)
+
+    def delete_cr(self, group: str, version: str, plural: str,
+                  namespace: str, name: str) -> None:
+        try:
+            self._request(
+                "DELETE",
+                f"/apis/{group}/{version}/namespaces/{quote(namespace)}/"
+                f"{plural}/{quote(name)}")
+        except KubeApiError as e:
+            if e.code != 404:
+                raise
+
+    def list_cr(self, group: str, version: str, plural: str) -> list[dict]:
+        return self._request(
+            "GET", f"/apis/{group}/{version}/{plural}").get("items", [])
+
+    # ------------------------------------------- envtest-style helpers --
+
+    def set_phase(self, namespace: str, name: str, phase: PodPhase,
+                  exit_code: Optional[int] = None) -> None:
+        """Drive a pod's phase THROUGH the apiserver (the test suite's
+        kubelet role — same surface FakeCluster exposes in-memory)."""
+        status: dict = {"phase": phase.value}
+        if exit_code is not None:
+            status["containerStatuses"] = [{
+                "name": "worker",
+                "state": {"terminated": {"exitCode": int(exit_code)}}}]
+        self._request(
+            "PATCH", self._pod_path(namespace, name, "status"),
+            {"status": status},
+            content_type="application/merge-patch+json")
+        self.get_pod(namespace, name)      # fold into the cache now
+
+    def run_scheduled(self) -> None:
+        """Pretend kubelet: every gate-lifted Pending pod goes Running."""
+        with self._lock:
+            keys = [k for k, p in self._pods.items()
+                    if p.phase == PodPhase.PENDING and p.scheduled
+                    and k not in self._gated]
+        for ns, name in keys:
+            self.set_phase(ns, name, PodPhase.RUNNING)
+
+
+_JOB_PLURALS = {
+    "JAXJob": "jaxjobs", "TFJob": "tfjobs",
+    "PyTorchJob": "pytorchjobs", "XGBoostJob": "xgboostjobs",
+}
+JOB_CR_GROUP = "kubeflow-tpu.org"
+JOB_CR_VERSION = "v1"
+
+
+class JobCRStore:
+    """Jobs as custom resources IN the apiserver — the reference's etcd
+    role. The controller is stateless for job specs: submit persists the
+    CR (spec + uid + terminal condition), delete removes it, and a
+    restarted controller `load_all()`s and adopts its existing pods (the
+    uid round-trips, so the job-uid pod selector still matches).
+    Wire via ``JobController.job_store``."""
+
+    def __init__(self, cluster: KubeCluster):
+        self.cluster = cluster
+
+    @staticmethod
+    def _plural(kind: str) -> str:
+        return _JOB_PLURALS.get(kind, kind.lower() + "s")
+
+    def save(self, job) -> None:
+        from kubeflow_tpu.api.types import to_yaml
+        import yaml as _yaml
+
+        doc = _yaml.safe_load(to_yaml(job))
+        self.cluster.save_cr(
+            JOB_CR_GROUP, JOB_CR_VERSION, self._plural(job.kind),
+            job.namespace, job.name, doc)
+
+    def delete(self, job) -> None:
+        self.cluster.delete_cr(
+            JOB_CR_GROUP, JOB_CR_VERSION, self._plural(job.kind),
+            job.namespace, job.name)
+
+    def load_all(self) -> list:
+        from kubeflow_tpu.api.types import from_yaml
+        import yaml as _yaml
+
+        out = []
+        for plural in _JOB_PLURALS.values():
+            try:
+                docs = self.cluster.list_cr(
+                    JOB_CR_GROUP, JOB_CR_VERSION, plural)
+            except KubeApiError:
+                continue
+            for doc in docs:
+                out.append(from_yaml(_yaml.safe_dump(doc)))
+        return out

@@ -71,6 +71,10 @@ class JobController:
         # SDK, HPO trial jobs) — quota enforcement lives here, not in the
         # HTTP-facing wrapper, so nothing can route around it
         self.admission_checks: list = []
+        # optional durable job-spec store (KubeCluster: JobCRStore — the
+        # jobs live as CRs in the apiserver, the reference's etcd role);
+        # submit/delete/condition changes write through it
+        self.job_store = None
 
     # ---------------- apiserver-ish surface ----------------
 
@@ -83,7 +87,11 @@ class JobController:
             raise KeyError(f"job {key} already exists")
         for check in self.admission_checks:
             check(job)
-        job.uid = job.uid or uuid.uuid4().hex[:12]
+        # ALWAYS server-generated (client YAML may echo an exported uid —
+        # honoring it would let a resubmission adopt a dead incarnation's
+        # terminal pods and "succeed" without running); restore() is the
+        # only path that keeps a uid
+        job.uid = uuid.uuid4().hex[:12]
         job.status = JobStatus()
         self._set_condition(job, ConditionType.CREATED, "JobCreated")
         job.status.start_time = time.time()
@@ -91,6 +99,21 @@ class JobController:
         # register the gang group at submission so a later admission cycle
         # sees all queued jobs and can order by priority, not arrival
         if job.run_policy.scheduling.gang and not job.run_policy.suspend:
+            self._ensure_podgroup(job)
+        if self.job_store is not None:
+            self.job_store.save(job)
+        return job
+
+    def restore(self, job: JobSpec) -> JobSpec:
+        """Re-adopt a job loaded from the durable store after a controller
+        restart: no re-validation/quota (it was admitted once), uid kept so
+        existing pods still match the job-uid selector, gang group
+        re-registered for unfinished jobs."""
+        key = (job.namespace, job.name)
+        self.jobs[key] = job
+        if (not job.status.is_finished()
+                and job.run_policy.scheduling.gang
+                and not job.run_policy.suspend):
             self._ensure_podgroup(job)
         return job
 
@@ -103,6 +126,8 @@ class JobController:
             self._delete_pods(job)
             self.cluster.delete_service(namespace, job.name)
             self.scheduler.remove_group(namespace, job.name)
+            if self.job_store is not None:
+                self.job_store.delete(job)
 
     # ---------------- reconcile ----------------
 
@@ -178,12 +203,25 @@ class JobController:
                 if self.cluster.get_pod(job.namespace, name) is None:
                     env = self.cluster_env(job, rtype, i)
                     env.update(spec.template.env)
+                    tpu = spec.template.tpu
                     pod = Pod(
                         name=name, namespace=job.namespace,
                         labels={**_job_selector(job), "replica-type": rtype,
                                 "replica-index": str(i)},
                         env=env,
                         command=list(spec.template.command),
+                        image=spec.template.image,
+                        # GKE TPU scheduling contract (BASELINE.md): slice
+                        # topology selectors + google.com/tpu, never GPUs
+                        node_selector={
+                            "cloud.google.com/gke-tpu-accelerator":
+                                f"tpu-{tpu.accelerator}",
+                            "cloud.google.com/gke-tpu-topology":
+                                tpu.topology,
+                        } if tpu is not None else {},
+                        resources={
+                            "google.com/tpu": str(tpu.chips_per_host),
+                        } if tpu is not None else {},
                     )
                     if self.pod_mutator is not None:
                         pod = self.pod_mutator(pod)
@@ -217,8 +255,12 @@ class JobController:
         for pod in pods:
             if pod.phase == PodPhase.PENDING and not pod.scheduled:
                 pod.scheduled = True
-                if isinstance(self.cluster, LocalProcessCluster):
-                    self.cluster.start_pod(pod)
+                # backend's admission hook: LocalProcessCluster launches the
+                # process; KubeCluster lifts the scheduling gate + publishes
+                # late-bound env; FakeCluster has none (tests play kubelet)
+                start = getattr(self.cluster, "start_pod", None)
+                if start is not None:
+                    start(pod)
 
     def cluster_env(self, job: JobSpec, rtype: str, index: int) -> dict[str, str]:
         """Per-kind rendezvous env (the reference's SetClusterSpec equivalent)."""
@@ -424,3 +466,10 @@ class JobController:
         job.status.conditions.append(
             Condition(type=ctype, reason=reason, message=message)
         )
+        if self.job_store is not None and (job.namespace, job.name) in self.jobs:
+            # status write-through (the CR status-subresource role) so a
+            # restarted controller never re-runs a finished job
+            try:
+                self.job_store.save(job)
+            except Exception:
+                pass      # durable status is best-effort; pods are truth

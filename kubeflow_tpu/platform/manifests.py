@@ -25,6 +25,8 @@ VERSION = "v1"
 CRD_KINDS = [
     ("jaxjobs", "JAXJob"),
     ("tfjobs", "TFJob"),
+    ("pytorchjobs", "PyTorchJob"),
+    ("xgboostjobs", "XGBoostJob"),
     ("experiments", "Experiment"),
     ("trials", "Trial"),
     ("inferenceservices", "InferenceService"),

@@ -27,8 +27,36 @@ class WorldInfo:
         return self.process_id == 0
 
 
+def load_downward_env(path: str = "/etc/podinfo/annotations",
+                      env: Optional[dict] = None) -> dict:
+    """Fold late-bound pod annotations into the env contract.
+
+    On a real cluster (controller/kube.py KubeCluster), values decided at
+    gang admission — after the pod spec is immutable — travel as
+    ``kubeflow-tpu.org/env.<KEY>`` annotations surfaced through a
+    downward-API volume. The file format is one ``key="escaped value"``
+    per line. Direct env always wins; annotations only fill gaps."""
+    env = env if env is not None else os.environ
+    if not os.path.exists(path):
+        return dict(env)
+    out = dict(env)
+    prefix = "kubeflow-tpu.org/env."
+    with open(path) as f:
+        for line in f:
+            key, eq, raw = line.strip().partition("=")
+            if not eq or not key.startswith(prefix):
+                continue
+            val = raw.strip()
+            if val.startswith('"') and val.endswith('"'):
+                # downward-API files escape values Go-string style
+                val = val[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+            out.setdefault(key[len(prefix):], val)
+    return out
+
+
 def world_from_env(env: Optional[dict] = None) -> WorldInfo:
     env = env if env is not None else os.environ
+    env = load_downward_env(env=env)
     return WorldInfo(
         coordinator=env.get("KFT_COORDINATOR", "127.0.0.1:8476"),
         num_processes=int(env.get("KFT_NUM_PROCESSES", "1")),
@@ -55,7 +83,7 @@ def initialize(env: Optional[dict] = None, timeout_s: float = 300.0):
         )
     from kubeflow_tpu.parallel.mesh import mesh_from_topology_env
 
-    mesh = mesh_from_topology_env(dict(env if env is not None else os.environ))
+    mesh = mesh_from_topology_env(load_downward_env(env=env))
     return world, mesh
 
 

@@ -43,3 +43,35 @@ def mesh_fsdp8():
 def mesh_expert():
     """data=2 x expert=4 mesh for MoE expert-parallel tests."""
     return build_mesh(MeshConfig(data=2, fsdp=1, expert=4))
+
+
+_kube_servers = []
+
+
+def make_test_cluster():
+    """Cluster factory for the controller suites. Default: FakeCluster.
+    KFT_TEST_CLUSTER=kube swaps in KubeCluster over an in-process fake
+    apiserver (the envtest role), so the SAME suites prove the reconciler
+    drives a Kubernetes REST API — pod phases then travel through status
+    PATCHes instead of in-memory pokes."""
+    if os.environ.get("KFT_TEST_CLUSTER") == "kube":
+        from kubeflow_tpu.controller import FakeKubeApiServer, KubeCluster
+
+        srv = FakeKubeApiServer().start()
+        _kube_servers.append(srv)
+        cluster = KubeCluster(srv.url)
+        cluster._test_server = srv
+        return cluster
+    from kubeflow_tpu.controller import FakeCluster
+
+    return FakeCluster()
+
+
+@pytest.fixture(autouse=True)
+def _stop_kube_servers():
+    """Release each test's fake apiservers (threads + sockets) at test
+    teardown instead of accumulating them for the whole session."""
+    mark = len(_kube_servers)
+    yield
+    while len(_kube_servers) > mark:
+        _kube_servers.pop().stop()

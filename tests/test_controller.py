@@ -13,8 +13,11 @@ from kubeflow_tpu.controller import (
 )
 
 
+from conftest import make_test_cluster
+
+
 def make_controller(hosts=64):
-    cluster = FakeCluster()
+    cluster = make_test_cluster()
     sched = GangScheduler({"any": SlicePool(total_hosts=hosts, free_hosts=hosts)})
     return JobController(cluster, sched), cluster
 

@@ -6,6 +6,8 @@ import time
 from kubeflow_tpu.controller import GangScheduler, PodGroup, SlicePool
 from kubeflow_tpu.controller.gang import TpuSlice, topology_hosts
 
+from conftest import make_test_cluster
+
 
 def _pool(*topos, acc="v5e"):
     return SlicePool(accelerator=acc, slices=[
@@ -131,7 +133,7 @@ def test_topology_derives_default_mesh_env():
     from kubeflow_tpu.api.types import TPUSpec, jax_job
     from kubeflow_tpu.controller import FakeCluster, JobController
 
-    ctl = JobController(FakeCluster())
+    ctl = JobController(make_test_cluster())
     # 8 workers of a 4-host "4x4" slice type -> 2 slices of 16 chips
     job = jax_job("topo", workers=8, tpu=TPUSpec("v5e", "4x4"))
     ctl.submit(job)
@@ -184,7 +186,7 @@ def test_slice_id_placement_hint_reaches_pods():
     from kubeflow_tpu.controller import FakeCluster, JobController
 
     sched = GangScheduler({"v5e": _pool("4x4", "4x4")})
-    cluster = FakeCluster()
+    cluster = make_test_cluster()
     ctl = JobController(cluster, sched)
     job = jax_job("pp", workers=8, tpu=TPUSpec("v5e", "4x4"),
                   mesh={"data": 8})

@@ -1,0 +1,460 @@
+"""KubeCluster + fake apiserver: the reconciler over the Kubernetes REST
+API (SURVEY.md §3.1 client-go informer role; §4.2 envtest pattern — 'pods
+are created but never run', tests drive phases by PATCHing status).
+
+test_controller.py / test_gang.py re-run UNCHANGED over this backend when
+KFT_TEST_CLUSTER=kube (wired into `make ci`); this module covers what those
+suites cannot: wire-level manifests, watch streams, scheduling gates,
+annotation-borne late env, terminal-wins merging, and the install-path
+round trip for platform/manifests.py output.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+import yaml
+
+from kubeflow_tpu.api.types import ConditionType, RunPolicy, TPUSpec, jax_job
+from kubeflow_tpu.controller import (
+    FakeKubeApiServer, GangScheduler, JobController, KubeCluster, PodPhase,
+    SlicePool, pod_name,
+)
+from kubeflow_tpu.controller.kube import (
+    ENV_ANNOTATION_PREFIX, GANG_GATE, KubeApiError, pod_to_manifest,
+)
+from kubeflow_tpu.controller.cluster import Pod, Service
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeKubeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def kube(apiserver):
+    return KubeCluster(apiserver.url)
+
+
+def make_controller(kube, hosts=64):
+    sched = GangScheduler({
+        "any": SlicePool(total_hosts=hosts, free_hosts=hosts),
+        "v5p": SlicePool(total_hosts=hosts, free_hosts=hosts),
+    })
+    return JobController(kube, sched)
+
+
+# ------------------------------------------------------------ manifests --
+
+def test_pod_manifest_renders_tpu_contract(kube):
+    pod = Pod(
+        name="w-0", namespace="ns", labels={"job-name": "w"},
+        env={"KFT_PROCESS_ID": "0"}, command=["python", "-m", "train"],
+        node_selector={"cloud.google.com/gke-tpu-accelerator": "tpu-v5p",
+                       "cloud.google.com/gke-tpu-topology": "2x2x1"},
+        resources={"google.com/tpu": "4"},
+    )
+    doc = pod_to_manifest(pod, "img:latest")
+    assert doc["spec"]["schedulingGates"] == [{"name": GANG_GATE}]
+    assert doc["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"] == "2x2x1"
+    limits = doc["spec"]["containers"][0]["resources"]["limits"]
+    assert limits == {"google.com/tpu": "4"}
+    assert "nvidia.com/gpu" not in json.dumps(doc)
+    # downward-API podinfo volume for late-bound admission env
+    assert doc["spec"]["volumes"][0]["downwardAPI"]
+
+
+def test_create_conflict_maps_to_keyerror(kube):
+    pod = Pod(name="dup", namespace="default", labels={}, env={},
+              command=[])
+    kube.create_pod(pod)
+    with pytest.raises(KeyError):
+        kube.create_pod(Pod(name="dup", namespace="default", labels={},
+                            env={}, command=[]))
+
+
+# -------------------------------------------------- gates + annotations --
+
+def test_gang_admission_lifts_gate_and_publishes_env(apiserver, kube):
+    ctl = make_controller(kube)
+    job = jax_job("gated", workers=2, mesh={"data": 2},
+                  tpu=TPUSpec("v5p", "2x2x1"))
+    ctl.submit(job)
+    ctl.reconcile("default", "gated")
+    name = pod_name(job, "Worker", 0)
+    doc = apiserver.get("api/v1/pods", "default", name)
+    # admitted in the same reconcile: gate lifted THROUGH the API
+    assert doc["spec"]["schedulingGates"] == []
+    # late-bound slice assignment traveled as an annotation
+    ann = doc["metadata"]["annotations"]
+    slice_keys = [k for k in ann if k == ENV_ANNOTATION_PREFIX + "KFT_SLICE_ID"]
+    assert slice_keys, ann
+
+
+def test_gate_stays_until_capacity(apiserver, kube):
+    ctl = make_controller(kube, hosts=2)
+    ctl.submit(jax_job("first", workers=2, mesh={"data": 2}))
+    ctl.reconcile("default", "first")
+    ctl.submit(jax_job("second", workers=2, mesh={"data": 2}))
+    ctl.reconcile("default", "second")
+    doc = apiserver.get("api/v1/pods", "default", "second-worker-0")
+    assert doc["spec"]["schedulingGates"] == [{"name": GANG_GATE}]
+    # a real kube-scheduler would therefore never place this pod early
+
+
+# --------------------------------------------------------- status flow --
+
+def test_full_lifecycle_through_status_patches(apiserver, kube):
+    ctl = make_controller(kube)
+    job = jax_job("life", workers=2, mesh={"data": 2})
+    ctl.submit(job)
+    ctl.reconcile("default", "life")
+    kube.run_scheduled()
+    ctl.reconcile("default", "life")
+    assert job.status.condition() == ConditionType.RUNNING
+    for i in range(2):
+        kube.set_phase("default", pod_name(job, "Worker", i),
+                       PodPhase.SUCCEEDED, 0)
+    ctl.reconcile("default", "life")
+    assert job.status.condition() == ConditionType.SUCCEEDED
+    # default CleanPodPolicy=Running keeps terminal pods; an explicit
+    # delete must clean the server side too
+    ctl.delete("default", "life")
+    assert apiserver.count("api/v1/pods") == 0
+
+
+def test_exit_code_travels_via_container_status(apiserver, kube):
+    ctl = make_controller(kube)
+    job = jax_job("ec", workers=1, run_policy=RunPolicy(backoff_limit=0))
+    ctl.submit(job)
+    ctl.reconcile("default", "ec")
+    kube.run_scheduled()
+    kube.set_phase("default", pod_name(job, "Worker", 0),
+                   PodPhase.FAILED, 137)
+    pod = kube.get_pod("default", pod_name(job, "Worker", 0))
+    assert pod.phase == PodPhase.FAILED and pod.exit_code == 137
+    doc = apiserver.get("api/v1/pods", "default", pod_name(job, "Worker", 0))
+    term = doc["status"]["containerStatuses"][0]["state"]["terminated"]
+    assert term["exitCode"] == 137
+
+
+def test_terminal_wins_over_remote_running(kube):
+    """A heartbeat-declared failure (controller-side pod.phase=FAILED) must
+    survive the next sync even while the kubelet still reports Running —
+    phase monotonicity, the informer-cache merge rule."""
+    pod = Pod(name="hb", namespace="default", labels={"job-name": "j"},
+              env={}, command=[])
+    kube.create_pod(pod)
+    kube.set_phase("default", "hb", PodPhase.RUNNING)
+    got = kube.get_pod("default", "hb")
+    assert got.phase == PodPhase.RUNNING
+    got.phase = PodPhase.FAILED          # what check_heartbeats does
+    got.exit_code = -1
+    again = kube.get_pod("default", "hb")
+    assert again is got
+    assert again.phase == PodPhase.FAILED and again.exit_code == -1
+
+
+# ------------------------------------------------------------ services --
+
+def test_service_round_trip_and_resolve(kube):
+    kube.create_service(Service(name="rv", namespace="ns",
+                                selector={"job-name": "rv"}, port=8476))
+    fresh = KubeCluster(f"http://{kube.host}:{kube.port}")
+    svc = fresh.get_service("ns", "rv")
+    assert svc is not None and svc.port == 8476
+    assert fresh.resolve("ns", "rv") == "rv.ns.svc:8476"
+    kube.delete_service("ns", "rv")
+    assert KubeCluster(f"http://{kube.host}:{kube.port}").get_service(
+        "ns", "rv") is None
+
+
+# ------------------------------------------------------------- watches --
+
+def test_watch_streams_phase_changes(kube):
+    pod = Pod(name="w", namespace="default", labels={"app": "x"},
+              env={}, command=[])
+    kube.create_pod(pod)
+    events = []
+    done = threading.Event()
+
+    def consume():
+        for etype, p in kube.watch_pods("default", {"app": "x"},
+                                        timeout_s=10):
+            events.append((etype, p.phase))
+            if etype == "DELETED":
+                break
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    kube.set_phase("default", "w", PodPhase.RUNNING)
+    kube.set_phase("default", "w", PodPhase.SUCCEEDED, 0)
+    kube.delete_pod("default", "w")
+    assert done.wait(15), events
+    phases = [ph for _, ph in events]
+    assert PodPhase.RUNNING in phases and PodPhase.SUCCEEDED in phases
+    assert events[-1][0] == "DELETED"
+
+
+def test_informer_keeps_cache_fresh_without_reads(kube):
+    pod = Pod(name="inf", namespace="default", labels={}, env={},
+              command=[])
+    kube.create_pod(pod)
+    kube.start_informer("default")
+    try:
+        # patch status directly against the server, bypassing this client's
+        # read path entirely: only the informer can observe it
+        kube._request(
+            "PATCH", kube._pod_path("default", "inf", "status"),
+            {"status": {"phase": "Running"}},
+            content_type="application/merge-patch+json")
+        deadline = time.time() + 10
+        while time.time() < deadline and pod.phase != PodPhase.RUNNING:
+            time.sleep(0.05)
+        assert pod.phase == PodPhase.RUNNING
+    finally:
+        kube.stop_informer()
+
+
+# ----------------------------------------------- adoption after restart --
+
+def test_fresh_client_adopts_existing_pods(apiserver, kube):
+    """Controller restart: a NEW KubeCluster must reconstruct Pods (env,
+    labels, gate state, annotations) from the apiserver alone."""
+    ctl = make_controller(kube)
+    job = jax_job("adopt", workers=2, mesh={"data": 2})
+    ctl.submit(job)
+    ctl.reconcile("default", "adopt")
+
+    fresh = KubeCluster(apiserver.url)
+    pods = fresh.list_pods("default", {"job-name": "adopt"})
+    assert len(pods) == 2
+    p0 = next(p for p in pods
+              if p.labels.get("replica-index") == "0")
+    assert p0.env["KFT_PROCESS_ID"] == "0"
+    assert p0.env["KFT_NUM_PROCESSES"] == "2"
+    assert p0.scheduled            # gate was lifted pre-restart
+
+
+# ------------------------------------------------------- install path --
+
+def test_platform_manifests_round_trip(apiserver, kube):
+    """`render_platform()` output applies document-by-document through the
+    same client (the kubectl/install role) and every object lands."""
+    from kubeflow_tpu.platform.manifests import render_platform
+
+    docs = [d for d in yaml.safe_load_all(render_platform()) if d]
+    for doc in docs:
+        kube.apply(doc)
+    # re-apply is idempotent (POST 409 -> PUT replace)
+    for doc in docs:
+        kube.apply(doc)
+    kinds = {d["kind"] for d in docs}
+    assert {"Namespace", "CustomResourceDefinition", "Deployment",
+            "Service", "ConfigMap"} <= kinds
+    assert apiserver.count(
+        "apis/apiextensions.k8s.io/v1/customresourcedefinitions") >= 3
+    assert apiserver.count("apis/apps/v1/deployments") >= 1
+
+
+# ------------------------------------------------ downward-API env path --
+
+def test_bootstrap_reads_annotation_env(tmp_path):
+    from kubeflow_tpu.rendezvous.bootstrap import load_downward_env
+
+    f = tmp_path / "annotations"
+    f.write_text(
+        'kubeflow-tpu.org/env.KFT_SLICE_ID="v5p-3"\n'
+        'kubeflow-tpu.org/env.KFT_MESH="data=2"\n'
+        'kubernetes.io/config.seen="2024"\n')
+    env = load_downward_env(str(f), env={"KFT_MESH": "data=4"})
+    assert env["KFT_SLICE_ID"] == "v5p-3"
+    assert env["KFT_MESH"] == "data=4"       # direct env wins
+    assert "kubernetes.io/config.seen" not in env
+
+
+# ------------------------------------------- daemon e2e over the REST API --
+
+def test_operator_daemon_drives_kube_backend(apiserver, tmp_path):
+    """The single-binary daemon with --cluster kube: submit over its REST
+    API, play kubelet by PATCHing pod status on the apiserver, job reaches
+    Succeeded — the GKE-deploy control loop end to end, minus the kubelet."""
+    import os
+    import subprocess
+    import sys
+    import urllib.request
+
+    env = {**os.environ,
+           "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.controller", "serve",
+         "--cluster", "kube", "--apiserver", apiserver.url,
+         "--port", "0", "--reconcile-period", "0.1",
+         "--state-dir", str(tmp_path / "state"),
+         "--heartbeat-dir", str(tmp_path / "hb")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        line = ""
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "serving on" in line:
+                break
+        assert "serving on" in line, "daemon did not start"
+        port = int(line.strip().rsplit(":", 1)[1])
+        base = f"http://127.0.0.1:{port}"
+
+        job_yaml = """
+apiVersion: kubeflow.org/v2
+kind: JAXJob
+metadata:
+  name: kube-e2e
+  namespace: default
+spec:
+  replicaSpecs:
+    Worker:
+      replicas: 2
+      template:
+        command: ["python", "-c", "pass"]
+"""
+        req = urllib.request.Request(
+            f"{base}/apis/v1/namespaces/default/jobs", method="POST",
+            data=job_yaml.encode(),
+            headers={"Content-Type": "application/yaml"})
+        with urllib.request.urlopen(req, timeout=20) as r:
+            assert r.status in (200, 201)
+
+        # pods must appear on the APISERVER, gates lifted by the daemon
+        kubelet = KubeCluster(apiserver.url)
+        deadline = time.time() + 60
+        pods = []
+        while time.time() < deadline:
+            pods = kubelet.list_pods("default", {"job-name": "kube-e2e"})
+            if len(pods) == 2 and all(p.scheduled for p in pods):
+                break
+            time.sleep(0.2)
+        assert len(pods) == 2 and all(p.scheduled for p in pods), pods
+
+        for p in pods:
+            kubelet.set_phase("default", p.name, PodPhase.RUNNING)
+        time.sleep(0.5)
+        for p in pods:
+            kubelet.set_phase("default", p.name, PodPhase.SUCCEEDED, 0)
+
+        deadline = time.time() + 60
+        doc = {}
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"{base}/apis/v1/namespaces/default/jobs/kube-e2e",
+                    timeout=10) as r:
+                doc = json.loads(r.read())
+            if doc.get("condition") in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+        assert doc.get("condition") == "Succeeded", doc
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+# -------------------------------------------------- CR-backed job store --
+
+def test_jobs_persist_as_crs_and_survive_controller_restart(apiserver, kube):
+    """The etcd role: submit writes the job CR; a FRESH controller (new
+    process in production) reloads it with the SAME uid, adopts the live
+    pods, and completes the job — no resubmission."""
+    from kubeflow_tpu.controller.kube import JobCRStore
+
+    ctl = make_controller(kube)
+    ctl.job_store = JobCRStore(kube)
+    job = jax_job("persist", workers=2, mesh={"data": 2})
+    ctl.submit(job)
+    ctl.reconcile("default", "persist")
+    kube.run_scheduled()
+    ctl.reconcile("default", "persist")
+    assert job.status.condition() == ConditionType.RUNNING
+    uid = job.uid
+    cr = apiserver.get("apis/kubeflow-tpu.org/v1/jaxjobs",
+                       "default", "persist")
+    assert cr is not None and cr["metadata"]["uid"] == uid
+    assert cr["status"]["condition"] == "Running"
+
+    # "restart": fresh client + fresh controller, loaded only from the API
+    fresh_kube = KubeCluster(apiserver.url)
+    ctl2 = make_controller(fresh_kube)
+    ctl2.job_store = JobCRStore(fresh_kube)
+    restored = ctl2.job_store.load_all()
+    assert len(restored) == 1 and restored[0].uid == uid
+    ctl2.restore(restored[0])
+    # adopted pods still match the round-tripped uid selector
+    pods = fresh_kube.list_pods(
+        "default", {"job-name": "persist", "job-uid": uid})
+    assert len(pods) == 2
+    for p in pods:
+        fresh_kube.set_phase("default", p.name, PodPhase.SUCCEEDED, 0)
+    ctl2.reconcile("default", "persist")
+    job2 = ctl2.get("default", "persist")
+    assert job2.status.condition() == ConditionType.SUCCEEDED
+    # terminal condition write-through: a THIRD controller must not re-run
+    third = JobCRStore(KubeCluster(apiserver.url)).load_all()[0]
+    assert third.status.is_finished()
+    # delete removes the CR
+    ctl2.delete("default", "persist")
+    assert apiserver.get("apis/kubeflow-tpu.org/v1/jaxjobs",
+                         "default", "persist") is None
+
+
+def test_restored_controller_lifts_gates_of_adopted_pods(apiserver, kube):
+    """A gang job still queued (gates set) when the controller dies must
+    get its gates lifted by the RESTARTED controller once capacity frees —
+    the adopted-pod gate state rebuilds from the server manifest."""
+    from kubeflow_tpu.controller.kube import JobCRStore
+
+    ctl = make_controller(kube, hosts=2)
+    ctl.job_store = JobCRStore(kube)
+    ctl.submit(jax_job("hog", workers=2, mesh={"data": 2}))
+    ctl.reconcile("default", "hog")
+    ctl.submit(jax_job("queued", workers=2, mesh={"data": 2}))
+    ctl.reconcile("default", "queued")
+    assert apiserver.get("api/v1/pods", "default",
+                         "queued-worker-0")["spec"]["schedulingGates"]
+
+    # controller dies; fresh one restores both jobs from CRs
+    fresh = KubeCluster(apiserver.url)
+    ctl2 = make_controller(fresh, hosts=2)
+    ctl2.job_store = JobCRStore(fresh)
+    for job in ctl2.job_store.load_all():
+        ctl2.restore(job)
+    # free capacity: hog succeeds and is deleted
+    for p in fresh.list_pods("default", {"job-name": "hog"}):
+        fresh.set_phase("default", p.name, PodPhase.SUCCEEDED, 0)
+    ctl2.reconcile("default", "hog")
+    ctl2.delete("default", "hog")
+    ctl2.reconcile("default", "queued")
+    doc = apiserver.get("api/v1/pods", "default", "queued-worker-0")
+    assert doc["spec"]["schedulingGates"] == [], (
+        "adopted pod's gate was never lifted")
+
+
+def test_submit_ignores_client_supplied_uid(kube):
+    """An exported spec echoes its uid; resubmitting it must get a FRESH
+    server-side uid so it can never adopt a dead incarnation's pods."""
+    from kubeflow_tpu.api.types import from_yaml, to_yaml
+
+    ctl = make_controller(kube)
+    job = ctl.submit(jax_job("fresh-uid", workers=1))
+    old_uid = job.uid
+    exported = to_yaml(job)
+    ctl.delete("default", "fresh-uid")
+    again = ctl.submit(from_yaml(exported))
+    assert again.uid and again.uid != old_uid
