@@ -9,7 +9,7 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube native native-asan test-native-asan dryrun clean
+.PHONY: ci test test-kube native native-asan test-native-asan dryrun scale-proof clean
 
 ci: test-native-asan test test-kube dryrun
 	@echo "CI OK"
@@ -41,6 +41,13 @@ test-native-asan: native-asan
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		$(PY) __graft_entry__.py dryrun 8
+
+# AOT scale proofs (BASELINE.md rows 4-5): compile 8B serving for a v5p-8
+# slice and the 70B FSDP train step for a 2-slice v5p-128 with the REAL
+# XLA:TPU compiler (compile-only topology, no TPU attached); fails if the
+# per-chip HBM requirement exceeds the 95G budget
+scale-proof:
+	JAX_PLATFORMS=cpu $(PY) -m kubeflow_tpu.parallel.aot
 
 clean:
 	$(MAKE) -C native/metadata_store clean

@@ -48,6 +48,7 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"            # "xla" | "flash" | "pallas"
+    attn_block: int = 512             # flash-kernel tile (VMEM budget knob)
     remat: str = "full"               # "none" | "full" | "dots"
     z_loss: float = 1e-4
     # MoE (0 experts = dense MLP). Mixtral-style: the FFN becomes a routed
@@ -241,7 +242,8 @@ def _block(x, lp, inv_freq, positions, cfg: LlamaConfig, mesh=None):
         attn_fn = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
         o = attn_fn(q, k, v, mesh, causal=True)
     else:
-        o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        o = attention(q, k, v, causal=True, impl=cfg.attn_impl,
+                      block_q=cfg.attn_block, block_kv=cfg.attn_block)
     o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
     x = x + constrain(o, ("batch", "seq", "act_embed"))
 
@@ -339,7 +341,8 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
         # pallas kernel for those — O(S) memory, CPU-interpretable)
         impl = cfg.attn_impl if cfg.attn_impl in ("xla", "flash", "pallas") \
             else "pallas"
-        o = attention(q, k, v, causal=True, impl=impl)
+        o = attention(q, k, v, causal=True, impl=impl,
+                      block_q=cfg.attn_block, block_kv=cfg.attn_block)
         o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

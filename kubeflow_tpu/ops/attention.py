@@ -97,9 +97,65 @@ def _attention_jit(
             return _xla_attention(q, k, v, causal=causal)
         from kubeflow_tpu.ops.pallas_attention import flash_attention as own_flash
 
-        return own_flash(q, k, v, causal=causal, block_q=block_q,
-                         block_kv=block_kv, interpret=platform == "cpu")
+        kernel = functools.partial(
+            own_flash, causal=causal, block_q=block_q,
+            block_kv=block_kv, interpret=platform == "cpu")
+        return _shard_mapped(kernel, q, k, v)
     return _xla_attention(q, k, v, causal=causal, q_offset=q_offset)
+
+
+def _ambient_mesh():
+    """The mesh in context at trace time: `with mesh:` populates the
+    thread-resource env (what with_sharding_constraint resolves against);
+    newer `jax.sharding.use_mesh` populates the abstract mesh instead —
+    accept either."""
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and abstract.axis_names:
+        return abstract
+    try:
+        from jax._src.mesh import thread_resources
+
+        physical = thread_resources.env.physical_mesh
+        if physical.axis_names:
+            return physical
+    except Exception:
+        pass
+    return None
+
+
+def _shard_mapped(kernel, q, k, v):
+    """Partition a Mosaic kernel over the ambient mesh.
+
+    XLA auto-partitions plain HLO, but Mosaic (Pallas) calls must be
+    wrapped in shard_map. Per the model's logical rules the flash kernel
+    parallelizes over batch (data/fsdp axes) and heads (tensor); sequence
+    stays local — context parallelism is ring/Ulysses attention's job
+    (parallel/ring_attention.py), never this kernel's."""
+    mesh = _ambient_mesh()
+    if mesh is None or not mesh.axis_names:
+        return kernel(q, k, v)
+    have = set(mesh.axis_names)
+    batch_axes = tuple(a for a in ("data", "fsdp")
+                       if a in have and mesh.shape[a] > 1)
+    head_axis = "tensor" if "tensor" in have and mesh.shape["tensor"] > 1 \
+        else None
+    if not batch_axes and head_axis is None:
+        return kernel(q, k, v)
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axes or None, None, head_axis, None)
+    try:
+        # check_vma=False: pallas_call's out_shape ShapeDtypeStructs carry
+        # no varying-mesh-axes annotation, which strict vma checking rejects
+        wrapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)
+    except (TypeError, AttributeError):   # older jax: no check_vma / no jax.shard_map
+        from kubeflow_tpu.parallel.ring_attention import shard_map
+
+        wrapped = shard_map(kernel, mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)
+    return wrapped(q, k, v)
 
 
 def _flash_attention(q, k, v, *, causal, block_q, block_kv):

@@ -169,30 +169,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, scale, block_q, block_kv, causal,
-                seq_q, groups, kv_len):
+                groups, kv_len):
+    """Grid (b, kvh, j, i): the query-block loop lives in the GRID (minor
+    dim i), with dk/dv revisit-accumulated across i — so VMEM holds one
+    [g, block_q, D] q/do window instead of the whole [g, S, D] sequence
+    (at S=8k the full-sequence window alone was 2×16 MB double-buffered,
+    overflowing v5p VMEM)."""
     j = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)                  # [bkv, D]
-    v = v_ref[0, 0].astype(jnp.float32)
-    d = k.shape[-1]
+    i = pl.program_id(3)
 
-    n_q = seq_q // block_q
-    lo = jax.lax.div(j * block_kv, block_q) if causal else 0
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
 
-    dk = jnp.zeros((block_kv, d), jnp.float32)
-    dv = jnp.zeros((block_kv, d), jnp.float32)
-    for g in range(groups):                               # static unroll
-        def body(i, carry):
-            dk, dv = carry
-            q = q_ref[0, g, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-            do = do_ref[0, g, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-            lse = lse_ref[0, g, 0, pl.ds(i * block_q, block_q)]
-            delta = delta_ref[0, g, 0, pl.ds(i * block_q, block_q)]
+    def contribute():
+        k = k_ref[0, 0].astype(jnp.float32)              # [bkv, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        d = k.shape[-1]
+        dk = jnp.zeros((block_kv, d), jnp.float32)
+        dv = jnp.zeros((block_kv, d), jnp.float32)
+        for g in range(groups):                          # static unroll
+            q = q_ref[0, g].astype(jnp.float32)          # [bq, D]
+            do = do_ref[0, g].astype(jnp.float32)
+            lse = lse_ref[0, g, 0, :]
+            delta = delta_ref[0, g, 0, :]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
             s = jnp.where(_mask(i, j, block_q, block_kv, causal, kv_len),
                           s, NEG_INF)
-            p = jnp.exp(s - lse[:, None])                 # [bq, bkv]
+            p = jnp.exp(s - lse[:, None])                # [bq, bkv]
             dv = dv + jax.lax.dot_general(
                 p, do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -203,12 +210,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dk = dk + jax.lax.dot_general(
                 ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            return dk, dv
+        dk_ref[0, 0] += dk
+        dv_ref[0, 0] += dv
 
-        dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk, dv))
-
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # q block i only reaches kv block j when its last row is at or
+        # below the diagonal; skipped steps cost one DMA, zero compute
+        pl.when((i + 1) * block_q - 1 >= j * block_kv)(contribute)
+    else:
+        contribute()
 
 
 def _bwd(causal, block_q, block_kv, kv_len, interpret, res, do):
@@ -242,30 +252,42 @@ def _bwd(causal, block_q, block_kv, kv_len, interpret, res, do):
         interpret=interpret,
     )(q, k, v, do, lse8, delta8)
 
+    # q/do tile over BOTH head-group and seq (grid dim i); dk/dv blocks are
+    # revisited across i (out index map ignores i) and accumulate in place
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
-            causal=causal, seq_q=sq, groups=g, kv_len=kv_len),
-        grid=(b, kvh, skv // block_kv),
+            causal=causal, groups=g, kv_len=kv_len),
+        grid=(b, kvh, skv // block_kv, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, g, sq, d), lambda bi, hi, j: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
-            pl.BlockSpec((1, g, sq, d), lambda bi, hi, j: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, g, 8, sq), lambda bi, hi, j: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, g, 8, sq), lambda bi, hi, j: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, g, block_q, d),
+                         lambda bi, hi, j, i: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, j, i: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, j, i: (bi, hi, j, 0)),
+            pl.BlockSpec((1, g, block_q, d),
+                         lambda bi, hi, j, i: (bi, hi, i, 0)),
+            pl.BlockSpec((1, g, 8, block_q),
+                         lambda bi, hi, j, i: (bi, hi, 0, i)),
+            pl.BlockSpec((1, g, 8, block_q),
+                         lambda bi, hi, j, i: (bi, hi, 0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bi, hi, j: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, j, i: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, j, i: (bi, hi, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, kvh, skv, d), k.dtype),
-            jax.ShapeDtypeStruct((b, kvh, skv, d), v.dtype),
+            # f32: the blocks accumulate IN PLACE across the i grid dim —
+            # bf16 outputs would round the running sum every revisit
+            jax.ShapeDtypeStruct((b, kvh, skv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, skv, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse8, delta8)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------------

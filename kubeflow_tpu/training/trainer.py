@@ -220,11 +220,23 @@ class Trainer:
         return jax.jit(step, donate_argnums=donate_argnums)
 
     def train_step(self, batch):
-        self.params, self.opt_state, metrics = self.step_fn(
-            self.params, self.opt_state, batch
-        )
+        # the mesh context MUST be live at trace time: the model's logical
+        # activation constraints (parallel/sharding.constrain) resolve
+        # PartitionSpecs against the ambient mesh and silently no-op
+        # without one — which costs activation sharding (batch stays
+        # data-sharded only, fsdp/tensor axes unused) on multichip
+        with self.mesh:
+            self.params, self.opt_state, metrics = self.step_fn(
+                self.params, self.opt_state, batch
+            )
         self.step += 1
         return metrics
+
+    def lower_step(self, params_shapes, opt_shapes, batch_shapes):
+        """AOT entry (parallel/aot.py scale proofs): lower the train step
+        under the mesh so activation constraints bind, without arrays."""
+        with self.mesh:
+            return self.step_fn.lower(params_shapes, opt_shapes, batch_shapes)
 
 
 def lm_loss_fn(forward, cfg):

@@ -281,3 +281,22 @@ def test_hybrid_multislice_mesh_shapes():
 def test_mesh_rejects_bad_pipeline_factor():
     with pytest.raises(ValueError):
         build_mesh(MeshConfig(pipeline=3, fsdp=1))
+
+
+def test_aot_scale_proof_8b_serving_v5p8():
+    """BASELINE.md row 4 cannot run on single-chip CI, but the REAL
+    XLA:TPU compiler can prove it: AOT-compile the tensor-parallel 8B
+    serving hot path against a compile-only v5p-8 topology and assert the
+    per-chip HBM requirement fits. (The 70B/v5p-128 twin runs in
+    `make scale-proof` — its compile is too slow for the unit suite.)"""
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel.aot import aot_serve_proof
+
+    proof = aot_serve_proof(
+        llama.llama3_8b(), "v5p:2x2x1", tensor=4,
+        batch=8, max_seq=8192, name="llama3_8b-serve-v5p8")
+    assert proof.n_devices == 4
+    assert proof.mesh_axes == {"tensor": 4}
+    # bf16 8B params / 4 chips ~ 4G + KV pool: sane, and far under budget
+    assert 3.0 < proof.argument_gb < 20.0
+    assert proof.fits, proof.to_dict()
