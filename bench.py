@@ -103,6 +103,9 @@ def main():
     # free the training state first (donated buffers die with the trainer)
     del trainer, m
     serve = _serving_bench(dev, on_tpu)
+    parity = _kernel_parity(on_tpu)
+    submit_latency = _submit_to_first_step_bench()
+    proofs = _scale_proofs()
 
     print(json.dumps({
         "metric": "llama1b_train_tokens_per_sec_per_chip",
@@ -119,6 +122,16 @@ def main():
             "loss": round(loss, 4),
             "input_pipeline": "fresh host batch put_batch'd every step",
             "serving": serve,
+            # north-star metric #2 (BASELINE.md row 2): the REAL operator
+            # daemon loops drive a 2-worker JAXJob from HTTP-submit to its
+            # first heartbeat-observed training step (CPU workers)
+            "submit_to_first_step_seconds": submit_latency,
+            # on-hardware parity of the first-party flash kernel vs XLA
+            # attention (fwd + grad), incl. a non-128-multiple sequence
+            "pallas_parity": parity,
+            # AOT scale proofs (BASELINE.md rows 4-5): per-chip HBM from
+            # the real XLA:TPU compiler for the big configs CI can't run
+            "scale_proofs": proofs,
             # scope note: BASELINE's north star is Llama-3-8B on v5p; this
             # chip is a single 16G-HBM v5e, so the 1B config is the
             # largest honest single-chip proxy. MFU is the comparable
@@ -151,25 +164,139 @@ def _serving_bench(dev, on_tpu: bool) -> dict:
     import numpy as np
 
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
-               for _ in range(max_batch)]
-    eng.generate(prompts[:1], SamplingParams(max_tokens=4))   # compile
-    # best-of-3: the remote-tunnel chip's RTT fluctuates enough to swing a
-    # single pass ±40%; the best pass is the honest capability number
-    best = 0.0
-    for _ in range(3 if on_tpu else 1):
+    n_passes = 3 if on_tpu else 1
+    # FRESH prompts per pass: identical prompts would hit the prefix cache
+    # on passes 2+ (prefill skipped entirely), quietly inflating the
+    # number. Every pass is cold. (Methodology change in round 4 — the
+    # round-3 BENCH took best-of-3 over one REUSED prompt set, so its
+    # serving number mixes warm-prefix passes; not directly comparable.)
+    passes = [[rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(max_batch)] for _ in range(n_passes)]
+    eng.generate(
+        [rng.integers(1, cfg.vocab_size, prompt_len).tolist()],
+        SamplingParams(max_tokens=4))                       # compile
+    rates = []
+    for prompts in passes:
         base_tokens = eng.generated_tokens
         t0 = time.perf_counter()
         reqs = eng.generate(prompts, SamplingParams(max_tokens=max_tokens))
         dt = time.perf_counter() - t0
         assert all(r.done for r in reqs)
-        best = max(best, (eng.generated_tokens - base_tokens) / dt)
+        rates.append((eng.generated_tokens - base_tokens) / dt)
+    rates.sort()
+    median = rates[len(rates) // 2]
     return {
-        "decode_tokens_per_sec": round(best, 1),
+        "decode_tokens_per_sec": round(median, 1),
+        "passes": [round(r, 1) for r in rates],
+        "methodology": "median of cold passes (fresh prompts; no prefix reuse)",
         "concurrent_requests": max_batch,
         "prompt_len": prompt_len,
         "max_tokens": max_tokens,
     }
+
+
+def _kernel_parity(on_tpu: bool) -> dict:
+    """Pallas-vs-XLA attention parity ON THE HARDWARE (fwd + grad), at the
+    bench shape and one non-128-multiple sequence. Compiled path, not
+    interpret mode — the number the kernel's correctness claim rests on."""
+    import numpy as np
+
+    from kubeflow_tpu.ops.attention import attention
+
+    if not on_tpu:
+        return {"skipped": "cpu (interpret-mode parity runs in the suite)"}
+    rng = np.random.default_rng(0)
+    out = {}
+    for label, (b, s, h, kvh, d) in {
+        "bench_shape": (2, 2048, 16, 8, 128),
+        "ragged_seq": (1, 640, 8, 4, 128),
+    }.items():
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, kvh, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, s, kvh, d)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+        def loss(impl):
+            return lambda q, k, v: (
+                attention(q, k, v, causal=True, impl=impl)
+                .astype(jnp.float32) * w).sum()
+
+        vp, gp = jax.jit(jax.value_and_grad(
+            loss("pallas"), argnums=(0, 1, 2)))(q, k, v)
+        vx, gx = jax.jit(jax.value_and_grad(
+            loss("xla"), argnums=(0, 1, 2)))(q, k, v)
+        gerr = max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b_.astype(jnp.float32))))
+            for a, b_ in zip(jax.device_get(gp), jax.device_get(gx)))
+        rel = abs(float(vp) - float(vx)) / (abs(float(vx)) + 1e-9)
+        out[label] = {"loss_rel_err": round(rel, 6),
+                      "grad_max_abs_err": round(gerr, 6),
+                      "within_tolerance": bool(rel < 2e-2 and gerr < 0.25)}
+        # a tolerance miss is REPORTED, never allowed to sink the bench
+        # line with the train/serving numbers already collected
+    return out
+
+
+def _submit_to_first_step_bench() -> dict:
+    """North-star #2 (BASELINE.md row 2): HTTP submit -> first observed
+    training step, measured by the real Operator daemon loops over a
+    LocalProcessCluster (workers pinned to CPU so they never touch the
+    bench chip's tunnel)."""
+    import os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.api.types import jax_job
+    from kubeflow_tpu.controller import (
+        JobController, LocalProcessCluster, Operator,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="kft-bench-op-")
+    cluster = LocalProcessCluster(log_dir=os.path.join(tmp, "pods"))
+    ctl = JobController(cluster)
+    op = Operator(ctl, heartbeat_dir=os.path.join(tmp, "hb"),
+                  reconcile_period=0.1, heartbeat_period=0.1)
+    op.start(port=0)
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        job = jax_job(
+            "bench-latency", workers=2, mesh={"data": 2},
+            command=[sys.executable, "-m",
+                     "kubeflow_tpu.rendezvous.worker_check"],
+            env={"PYTHONPATH": repo + ":" + os.environ.get("PYTHONPATH", ""),
+                 "KFT_FORCE_PLATFORM": "cpu",
+                 "KFT_TRAIN_STEPS": "3",
+                 "KFT_METRICS_PATH": os.path.join(tmp, "m.jsonl"),
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+        op.submit(job)
+        deadline = time.time() + 300
+        latency = None
+        while time.time() < deadline and latency is None:
+            latency = op.metrics.get(
+                "kft_submit_to_first_step_seconds",
+                {"namespace": "default", "job": "bench-latency"})
+            time.sleep(0.2)
+        if latency is None:
+            return {"error": "no first step within 300s"}
+        return {"seconds": round(float(latency), 2),
+                "workers": 2, "backend": "LocalProcessCluster/cpu"}
+    finally:
+        op.stop()
+        cluster.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _scale_proofs() -> list:
+    """AOT per-chip HBM proofs for the BASELINE configs this chip can't
+    run (8B serving on v5p-8; 70B FSDP on 2-slice v5p-128); ~3 min of
+    XLA:TPU compile time, no device memory touched."""
+    try:
+        from kubeflow_tpu.parallel.aot import scale_proofs
+
+        return [p.to_dict() for p in scale_proofs()]
+    except Exception as e:                     # never sink the bench line
+        return [{"error": f"{type(e).__name__}: {e}"}]
 
 
 if __name__ == "__main__":

@@ -634,8 +634,11 @@ def _make_http_server(op: Operator, port: int,
                 snap = op.dashboard.snapshot(user)
                 if self.path == "/apis/v1/dashboard":
                     return self._send(200, json.dumps(snap))
-                return self._send(200, op.dashboard.render_html(snap),
-                                  "text/html")
+                return self._send(
+                    200,
+                    op.dashboard.render_html(
+                        snap, webui_mounted=op.webui is not None),
+                    "text/html")
             ns, name = self._job_path()
             if ns and name:
                 job = op.controller.get(ns, name)

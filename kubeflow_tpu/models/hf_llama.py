@@ -64,6 +64,10 @@ def config_from_hf(d: dict[str, Any], **overrides) -> llama.LlamaConfig:
         norm_eps=float(d.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(d.get("tie_word_embeddings", False)),
     )
+    if d.get("num_local_experts"):
+        # Mixtral layout: routed FFN mixture, attention unchanged
+        kw["n_experts"] = int(d["num_local_experts"])
+        kw["moe_top_k"] = int(d.get("num_experts_per_tok", 2))
     kw.update(overrides)
     return llama.LlamaConfig(**kw)
 
@@ -90,6 +94,11 @@ def config_to_hf(cfg: llama.LlamaConfig) -> dict[str, Any]:
             "low_freq_factor": 1.0, "high_freq_factor": 4.0,
             "original_max_position_embeddings": cfg.max_seq,
         }
+    if cfg.n_experts:
+        d["architectures"] = ["MixtralForCausalLM"]
+        d["model_type"] = "mixtral"
+        d["num_local_experts"] = cfg.n_experts
+        d["num_experts_per_tok"] = cfg.moe_top_k
     return d
 
 
@@ -159,39 +168,102 @@ def load_params(model_dir: str, cfg: Optional[llama.LlamaConfig] = None, *,
     than its shard is device-resident per chip.
     """
     cfg = cfg or load_config(model_dir, dtype=dtype)
-    if cfg.n_experts:
-        raise NotImplementedError("HF MoE (Mixtral) import not wired yet")
     idx = _TensorIndex(model_dir)
     h, kv, hd, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.dim
 
     put = _placer(cfg, mesh, rules, dtype)
+    stack_shardings = None
+    if mesh is not None:
+        stack_shardings = shd.tree_shardings(
+            mesh, llama.param_logical_axes(cfg), rules)["layers"]
 
-    def layer_stack(fmt: str, transform) -> jax.Array:
-        return jnp.stack(
-            [transform(idx.get(fmt.format(i))) for i in range(cfg.n_layers)])
+    def layer_stack(fmt: str, transform, key: str = "") -> jax.Array:
+        """Stack per-layer tensors. With a mesh, the stack materializes
+        SHARD BY SHARD (jax.make_array_from_callback reading one layer
+        tensor at a time) — a 70B/Mixtral FFN stack never exists as one
+        host allocation; without a mesh, plain host stacking."""
+        if stack_shardings is None:
+            return jnp.stack([transform(idx.get(fmt.format(i)))
+                              for i in range(cfg.n_layers)])
+        sample = np.asarray(transform(idx.get(fmt.format(0))))
+        gshape = (cfg.n_layers,) + sample.shape
+
+        def cb(index):
+            li = index[0]
+            return np.stack([
+                np.asarray(transform(idx.get(fmt.format(i))))[
+                    tuple(index[1:])].astype(dtype)
+                for i in range(*li.indices(cfg.n_layers))])
+
+        return jax.make_array_from_callback(
+            gshape, stack_shardings[key], cb)
 
     layers = {
         "attn_norm": layer_stack(
-            "model.layers.{}.input_layernorm.weight", lambda w: w),
+            "model.layers.{}.input_layernorm.weight", lambda w: w,
+            "attn_norm"),
         "mlp_norm": layer_stack(
-            "model.layers.{}.post_attention_layernorm.weight", lambda w: w),
+            "model.layers.{}.post_attention_layernorm.weight", lambda w: w,
+            "mlp_norm"),
         "wq": layer_stack(
             "model.layers.{}.self_attn.q_proj.weight",
-            lambda w: _linear(w).reshape(d, h, hd)),
+            lambda w: _linear(w).reshape(d, h, hd), "wq"),
         "wk": layer_stack(
             "model.layers.{}.self_attn.k_proj.weight",
-            lambda w: _linear(w).reshape(d, kv, hd)),
+            lambda w: _linear(w).reshape(d, kv, hd), "wk"),
         "wv": layer_stack(
             "model.layers.{}.self_attn.v_proj.weight",
-            lambda w: _linear(w).reshape(d, kv, hd)),
+            lambda w: _linear(w).reshape(d, kv, hd), "wv"),
         "wo": layer_stack(
             "model.layers.{}.self_attn.o_proj.weight",
-            lambda w: _linear(w).reshape(h, hd, d)),
-        "w_gate": layer_stack(
-            "model.layers.{}.mlp.gate_proj.weight", _linear),
-        "w_up": layer_stack("model.layers.{}.mlp.up_proj.weight", _linear),
-        "w_down": layer_stack("model.layers.{}.mlp.down_proj.weight", _linear),
+            lambda w: _linear(w).reshape(h, hd, d), "wo"),
     }
+    if cfg.n_experts:
+        # Mixtral block_sparse_moe: router gate [E, d] -> [d, E]; per-expert
+        # w1(gate)/w3(up) [m, d] -> [d, m]; w2(down) [d, m] -> [m, d];
+        # experts stack on a leading E dim matching llama.init_params
+        E = cfg.n_experts
+
+        def expert_stack(fmt: str, key: str) -> jax.Array:
+            if stack_shardings is None:
+                return jnp.stack([
+                    jnp.stack([_linear(idx.get(fmt.format(i, e)))
+                               for e in range(E)])
+                    for i in range(cfg.n_layers)])
+            sample = np.asarray(_linear(idx.get(fmt.format(0, 0))))
+            gshape = (cfg.n_layers, E) + sample.shape
+
+            def cb(index):
+                li, ei = index[0], index[1]
+                return np.stack([
+                    np.stack([
+                        np.asarray(_linear(idx.get(fmt.format(i, e))))[
+                            tuple(index[2:])].astype(dtype)
+                        for e in range(*ei.indices(E))])
+                    for i in range(*li.indices(cfg.n_layers))])
+
+            return jax.make_array_from_callback(
+                gshape, stack_shardings[key], cb)
+
+        layers["moe_router"] = layer_stack(
+            "model.layers.{}.block_sparse_moe.gate.weight", _linear,
+            "moe_router")
+        layers["w_gate"] = expert_stack(
+            "model.layers.{}.block_sparse_moe.experts.{}.w1.weight",
+            "w_gate")
+        layers["w_up"] = expert_stack(
+            "model.layers.{}.block_sparse_moe.experts.{}.w3.weight",
+            "w_up")
+        layers["w_down"] = expert_stack(
+            "model.layers.{}.block_sparse_moe.experts.{}.w2.weight",
+            "w_down")
+    else:
+        layers["w_gate"] = layer_stack(
+            "model.layers.{}.mlp.gate_proj.weight", _linear, "w_gate")
+        layers["w_up"] = layer_stack(
+            "model.layers.{}.mlp.up_proj.weight", _linear, "w_up")
+        layers["w_down"] = layer_stack(
+            "model.layers.{}.mlp.down_proj.weight", _linear, "w_down")
     params = {
         "embed": idx.get("model.embed_tokens.weight"),
         "layers": layers,
@@ -244,9 +316,17 @@ def save_pretrained(model_dir: str, cfg: llama.LlamaConfig, params) -> None:
         flat[p + "self_attn.k_proj.weight"] = lp["wk"][i].reshape(d, kv * hd).T
         flat[p + "self_attn.v_proj.weight"] = lp["wv"][i].reshape(d, kv * hd).T
         flat[p + "self_attn.o_proj.weight"] = lp["wo"][i].reshape(h * hd, d).T
-        flat[p + "mlp.gate_proj.weight"] = lp["w_gate"][i].T
-        flat[p + "mlp.up_proj.weight"] = lp["w_up"][i].T
-        flat[p + "mlp.down_proj.weight"] = lp["w_down"][i].T
+        if cfg.n_experts:
+            flat[p + "block_sparse_moe.gate.weight"] = lp["moe_router"][i].T
+            for e in range(cfg.n_experts):
+                ep = p + f"block_sparse_moe.experts.{e}."
+                flat[ep + "w1.weight"] = lp["w_gate"][i, e].T
+                flat[ep + "w3.weight"] = lp["w_up"][i, e].T
+                flat[ep + "w2.weight"] = lp["w_down"][i, e].T
+        else:
+            flat[p + "mlp.gate_proj.weight"] = lp["w_gate"][i].T
+            flat[p + "mlp.up_proj.weight"] = lp["w_up"][i].T
+            flat[p + "mlp.down_proj.weight"] = lp["w_down"][i].T
     flat = {k: jnp.asarray(v) for k, v in flat.items()}
     _st_save(flat, os.path.join(model_dir, "model.safetensors"))
 

@@ -204,15 +204,18 @@ def param_logical_axes(cfg: LlamaConfig):
 # Forward
 # ---------------------------------------------------------------------------
 
-def _ffn(h, lp, cfg: LlamaConfig):
+def _ffn(h, lp, cfg: LlamaConfig, token_mask=None):
     """FFN half of a block on the normed input h: (delta, aux_loss_scalar).
-    Dense SwiGLU, or the routed MoE mixture when cfg.n_experts > 0."""
+    Dense SwiGLU, or the routed MoE mixture when cfg.n_experts > 0.
+    ``token_mask`` [B, S]: serving paths exclude pad/idle rows from MoE
+    routing (they would steal expert capacity from real tokens)."""
     if cfg.n_experts:
         from kubeflow_tpu.parallel.moe import moe_aux_total, moe_layer
 
         moe_params = {"router": lp["moe_router"], "w_gate": lp["w_gate"],
                       "w_up": lp["w_up"], "w_down": lp["w_down"]}
-        y, aux = moe_layer(moe_params, h, cfg.moe_config())
+        y, aux = moe_layer(moe_params, h, cfg.moe_config(),
+                           token_mask=token_mask)
         return y, moe_aux_total(aux)
     gate = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
     up = jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
@@ -346,7 +349,7 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
         o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        down, _ = _ffn(h, lp, cfg)
+        down, _ = _ffn(h, lp, cfg, token_mask=positions < lengths[:, None])
         x = x + down
         new_k = jax.lax.dynamic_update_slice(
             k_cache_l, k.astype(k_cache_l.dtype), (0, 0, 0, 0)
@@ -362,7 +365,8 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     last = jnp.take_along_axis(
-        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+        x, jnp.maximum(lengths - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1,
     )[:, 0]
     logits = jnp.einsum("bd,dv->bv", last, head.astype(cfg.dtype))
     cache = {"k": new_k, "v": new_v, "len": lengths.astype(jnp.int32)}
@@ -397,7 +401,7 @@ def decode_step(params, token, cfg: LlamaConfig, cache):
         o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        down, _ = _ffn(h, lp, cfg)
+        down, _ = _ffn(h, lp, cfg, token_mask=(pos > 0)[:, None])
         x = x + down
         return x, (new_k, new_v)
 

@@ -56,13 +56,19 @@ def moe_param_logical_axes(cfg: MoEConfig):
     }
 
 
-def moe_layer(params, x, cfg: MoEConfig, *, capacity: Optional[int] = None):
+def moe_layer(params, x, cfg: MoEConfig, *, capacity: Optional[int] = None,
+              token_mask=None):
     """Apply the MoE FFN. x: [B, S, D] -> (y [B, S, D], aux_losses dict).
 
     Dense dispatch: combine/dispatch tensors [G, E, C] (G = B*S tokens)
     contract tokens into per-expert capacity buffers and back. Sharding
     constraints place E on the `expert` mesh axis (all-to-all emitted by
     XLA) and tokens on the data axes.
+
+    ``token_mask`` [B, S] bool marks REAL tokens: padding rows (prefill
+    buckets, idle decode slots) must not route — garbage rows would
+    compete for expert capacity and displace real tokens' assignments,
+    changing real outputs (the serving-correctness failure mode).
     """
     b, s, d = x.shape
     g = b * s
@@ -73,6 +79,8 @@ def moe_layer(params, x, cfg: MoEConfig, *, capacity: Optional[int] = None):
     tokens = x.reshape(g, d)
     logits = tokens.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)                    # [G, E]
+    valid = (jnp.ones((g,), bool) if token_mask is None
+             else token_mask.reshape(g))
 
     # top-k expert choice per token
     topk_probs, topk_idx = jax.lax.top_k(probs, k)             # [G, k]
@@ -80,14 +88,58 @@ def moe_layer(params, x, cfg: MoEConfig, *, capacity: Optional[int] = None):
     topk_probs = topk_probs / jnp.maximum(
         topk_probs.sum(-1, keepdims=True), 1e-9)
 
+    # aux losses (float32, REAL tokens only) — ONE formula for both
+    # dispatch paths; each path adds only its own dropped fraction
+    vf = valid.astype(jnp.float32)
+    denom = jnp.maximum(vf.sum(), 1.0)
+    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    aux = {
+        # load balance: E * sum_e fraction_tokens_e * mean_router_prob_e
+        "moe_load_balance": cfg.load_balance_coef * e * jnp.sum(
+            ((top1 * vf[:, None]).sum(0) / denom)
+            * ((probs * vf[:, None]).sum(0) / denom)),
+        "moe_router_z": cfg.router_z_coef * jnp.sum(
+            jax.nn.logsumexp(logits, axis=-1) ** 2 * vf) / denom,
+    }
+
+    if cfg.capacity_factor <= 0:
+        # dropless-EXACT path (capacity_factor <= 0): every token's output
+        # is its true top-k mixture, independent of batch composition.
+        # Capacity buffers couple tokens ACROSS the batch (a garbage or
+        # neighbor row can displace a real token's assignment), which is
+        # fine as a training regularizer but wrong for serving, where the
+        # same prompt must decode identically at any batch size. Costs
+        # E/k x the routed FFN FLOPs (scan over experts, peak [G, m]).
+        gates = jnp.zeros((g, e), cfg.dtype)
+        for j in range(k):                     # static k
+            gates = gates + jax.nn.one_hot(
+                topk_idx[:, j], e, dtype=cfg.dtype) \
+                * topk_probs[:, j, None].astype(cfg.dtype)
+        gates = gates * valid[:, None].astype(cfg.dtype)
+        tk = tokens.astype(cfg.dtype)
+
+        def one_expert(y, xs):
+            wg, wu, wd, gate_e = xs
+            h = jax.nn.silu(tk @ wg.astype(cfg.dtype)) \
+                * (tk @ wu.astype(cfg.dtype))
+            return y + gate_e[:, None] * (h @ wd.astype(cfg.dtype)), None
+
+        y, _ = jax.lax.scan(
+            one_expert, jnp.zeros((g, d), cfg.dtype),
+            (params["w_gate"], params["w_up"], params["w_down"], gates.T))
+        aux["moe_dropped_fraction"] = jnp.zeros((), jnp.float32)
+        return y.reshape(b, s, d).astype(x.dtype), aux
+
     # position of each (token, choice) in its expert's capacity buffer:
     # cumulative count of prior assignments to the same expert. Flatten
     # choices in priority order (choice 0 of every token first).
     flat_idx = topk_idx.T.reshape(-1)                          # [k*G]
-    onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)      # [k*G, E]
+    flat_valid = jnp.tile(valid, k)                            # [k*G]
+    onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32) \
+        * flat_valid[:, None].astype(jnp.int32)                # [k*G, E]
     pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [k*G, E]
     pos = pos_in_expert.sum(-1)                                # [k*G]
-    keep = pos < capacity
+    keep = (pos < capacity) & flat_valid
     pos = jnp.where(keep, pos, 0)
 
     # dispatch/combine tensors
@@ -113,18 +165,9 @@ def moe_layer(params, x, cfg: MoEConfig, *, capacity: Optional[int] = None):
 
     y = jnp.einsum("gec,ecd->gd", combine, expert_out)
 
-    # aux losses (float32 for stability)
-    # load balance: E * sum_e fraction_tokens_e * mean_router_prob_e
-    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
-    frac_tokens = top1.mean(0)
-    frac_probs = probs.mean(0)
-    lb_loss = e * jnp.sum(frac_tokens * frac_probs)
-    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
-    aux = {
-        "moe_load_balance": cfg.load_balance_coef * lb_loss,
-        "moe_router_z": cfg.router_z_coef * z_loss,
-        "moe_dropped_fraction": (~keep).astype(jnp.float32).mean(),
-    }
+    aux["moe_dropped_fraction"] = ((~keep) & flat_valid).astype(
+        jnp.float32).sum() / jnp.maximum(
+        flat_valid.astype(jnp.float32).sum(), 1.0)
     return y.reshape(b, s, d).astype(x.dtype), aux
 
 

@@ -79,7 +79,7 @@ class Dashboard:
         return out
 
     @staticmethod
-    def render_html(snap: dict) -> str:
+    def render_html(snap: dict, webui_mounted: bool = False) -> str:
         """The ONE html renderer (operator route + standalone server).
         Tenant-chosen names land in this page, so everything is escaped —
         unescaped interpolation here is stored XSS against whoever views
@@ -90,8 +90,19 @@ class Dashboard:
             f"<h2>{_html.escape(str(k))}</h2>"
             f"<pre>{_html.escape(json.dumps(v, indent=1))}</pre>"
             for k, v in snap.items())
+        # /ui routes exist only when the operator mounts a WebUI; the
+        # standalone dashboard server must not render dead links
+        links = "".join(
+            f'<a href="{href}" style="margin-right:1rem">{label}</a>'
+            for href, label in (
+                ("/ui", "Web UI"), ("/ui/jobs", "Jobs"),
+                ("/ui/pipelines", "Pipelines"),
+                ("/ui/volumes", "Volumes &amp; artifacts"))
+        ) if webui_mounted else ""
+        nav = f"<nav>{links}</nav>" if links else ""
         return ("<html><title>kubeflow-tpu</title><body>"
-                f"<h1>kubeflow-tpu dashboard</h1>{rows}</body></html>")
+                f"<h1>kubeflow-tpu dashboard</h1>{nav}"
+                f"{rows}</body></html>")
 
     def serve(self, host: str = "127.0.0.1", port: int = 0):
         outer = self

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import html as _html
 import json
+import os
 from typing import Callable, Optional
 from urllib.parse import parse_qs
 
@@ -48,6 +49,7 @@ _NAV = (
     ("/ui", "Overview"), ("/ui/jobs", "Jobs"),
     ("/ui/experiments", "Experiments"), ("/ui/serving", "Serving"),
     ("/ui/pipelines", "Pipelines"), ("/ui/notebooks", "Notebooks"),
+    ("/ui/volumes", "Volumes"),
 )
 
 
@@ -154,6 +156,12 @@ class WebUI:
             return self._page("Pipelines", self.pipelines_list())
         if head == "notebooks":
             return self._page("Notebooks", self.notebooks_list(vis))
+        if head == "volumes":
+            if len(parts) >= 3 and parts[1] == "artifacts":
+                return self._page(
+                    f"Artifacts {parts[2]}",
+                    self.artifacts_detail(parts[2], parts[3:]))
+            return self._page("Volumes", self.volumes_list(vis))
         return _not_found()
 
     def _route_post(self, parts: list[str], form: dict, can) -> Response:
@@ -644,6 +652,88 @@ class WebUI:
                 '<input name="logdir" placeholder="logdir">'
                 "<button>Create tensorboard</button></form>")
         return "".join(out) or "<p>no notebook controllers wired</p>"
+
+
+    # ---------------- volumes + artifacts (the pvcviewer role) ----------
+
+    def volumes_list(self, vis) -> str:
+        """Storage browser: job-declared volume mounts (namespace-scoped)
+        and pipeline-run artifact stores — the pvcviewer-equivalent."""
+        out = []
+        if self.jobs is not None:
+            rows = []
+            for (ns, name), job in sorted(self.jobs.jobs.items()):
+                if not vis(ns):
+                    continue
+                for rtype, spec in job.replica_specs.items():
+                    for vol, mount in sorted(
+                            spec.template.volumes.items()):
+                        rows.append(
+                            f"<tr><td>{_E(ns)}</td>"
+                            f'<td><a href="/ui/jobs/{_E(ns)}/{_E(name)}">'
+                            f"{_E(name)}</a></td><td>{_E(rtype)}</td>"
+                            f"<td>{_E(vol)}</td>"
+                            f"<td><code>{_E(mount)}</code></td></tr>")
+            out.append(
+                "<h2>Job volume mounts</h2>"
+                "<table><tr><th>Namespace</th><th>Job</th><th>Replica</th>"
+                "<th>Volume</th><th>Mount</th></tr>"
+                + "".join(rows) + "</table>"
+                if rows else "<h2>Job volume mounts</h2><p>none declared</p>")
+        if self.pipelines is not None:
+            rows = "".join(
+                f'<tr><td><a href="/ui/volumes/artifacts/{_E(r.run_id)}">'
+                f"{_E(r.run_id)}</a></td>"
+                f"<td>{_pill(r.state.value if hasattr(r.state, 'value') else str(r.state))}</td></tr>"
+                for r in self.pipelines.list_runs())
+            out.append(
+                "<h2>Pipeline artifact stores</h2>"
+                "<table><tr><th>Run</th><th>State</th></tr>"
+                f"{rows}</table>")
+        return "".join(out) or "<p>no storage-backed controllers wired</p>"
+
+    def artifacts_detail(self, run_id: str, rest: list[str]) -> str:
+        """Browse one run's artifact directory; small text artifacts
+        render inline. Paths resolve strictly inside the run dir."""
+        if self.pipelines is None:
+            return "<p>no pipeline runner wired</p>"
+        workdir = getattr(self.pipelines.runner, "workdir", None)
+        if workdir is None:
+            return "<p>runner has no artifact directory</p>"
+        run_dir = os.path.realpath(os.path.join(workdir, run_id))
+        if (not run_dir.startswith(os.path.realpath(workdir) + os.sep)
+                or not os.path.isdir(run_dir)):
+            return "<p>not found</p>"
+        target = os.path.realpath(os.path.join(run_dir, *rest))
+        if not (target == run_dir
+                or target.startswith(run_dir + os.sep)) \
+                or not os.path.exists(target):
+            return "<p>not found</p>"
+        if os.path.isfile(target):
+            size = os.path.getsize(target)
+            if size > 65536:
+                return (f"<p>{_E(os.path.basename(target))}: {size} bytes "
+                        "(too large to preview)</p>")
+            with open(target, "rb") as f:
+                data = f.read()
+            try:
+                text = data.decode()
+            except UnicodeDecodeError:
+                return (f"<p>{_E(os.path.basename(target))}: {size} bytes "
+                        "(binary)</p>")
+            return f"<pre>{_E(text)}</pre>"
+        rows = []
+        for entry in sorted(os.listdir(target)):
+            full = os.path.join(target, entry)
+            href = "/".join(["/ui/volumes/artifacts", run_id]
+                            + rest + [entry])
+            kind = "dir" if os.path.isdir(full) else "file"
+            size = "" if kind == "dir" else str(os.path.getsize(full))
+            rows.append(
+                f'<tr><td><a href="{_E(href)}">{_E(entry)}</a></td>'
+                f"<td>{kind}</td><td>{size}</td></tr>")
+        return ("<table><tr><th>Name</th><th>Type</th><th>Bytes</th></tr>"
+                + "".join(rows) + "</table>") if rows else "<p>empty</p>"
 
 
 def _refs(v, ref_type):

@@ -204,7 +204,11 @@ class LLMModel(Model):
         from kubeflow_tpu.serving.tokenizer import load_tokenizer
 
         cfg, params = hf_llama.load_pretrained(
-            model_dir, dtype=dtype or jnp.bfloat16, mesh=mesh)
+            model_dir, dtype=dtype or jnp.bfloat16, mesh=mesh,
+            # serving is EXACT MoE: capacity buffers are a training
+            # regularizer; at inference the same prompt must decode
+            # identically at any batch size (parallel/moe.py dropless path)
+            moe_capacity_factor=0.0)
         tok = load_tokenizer(model_dir)
         kw.setdefault("max_seq", min(cfg.max_seq, 1024))
         return cls(name, params, cfg, tokenizer=tok, mesh=mesh, **kw)

@@ -119,6 +119,7 @@ class LLMEngine:
                  kv_block_size: Optional[int] = None,
                  kv_num_blocks: Optional[int] = None,
                  decode_chunk: int = 8,
+                 decode_pipeline: bool = True,
                  mesh=None):
         from kubeflow_tpu.serving.paged_kv import (
             PagedKV, _lm_head as lm_head_fn,
@@ -194,6 +195,15 @@ class LLMEngine:
         # the host; their overshoot tokens land in their own reserved blocks
         # or the scratch block, never another request's.
         self.decode_chunk = max(1, int(decode_chunk))
+        # double-buffered decode: dispatch chunk N+1 BEFORE fetching chunk
+        # N's tokens, so device compute overlaps host transfer+bookkeeping
+        # (critical on a remote-tunnel chip where each fetch pays an RTT).
+        # The next chunk's input token is the DEVICE-side scan carry; host
+        # token writes (fresh admissions) override it through a jitted
+        # merge, so the dispatch never waits on a host read-back.
+        self.decode_pipeline = bool(decode_pipeline)
+        self._inflight: Optional[dict] = None
+        self._fresh = np.ones((max_batch,), bool)   # host token overrides
 
         self._prefill = jax.jit(
             lambda p, toks, lens, cache: llama.prefill(
@@ -219,6 +229,8 @@ class LLMEngine:
                 jnp.take_along_axis(logits, tok[:, None], axis=-1)[:, 0]
                 - jax.nn.logsumexp(logits, axis=-1)))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
+        self._merge_tok = jax.jit(
+            lambda carry, upd, mask: jnp.where(mask, upd, carry))
         self._insert_batch = jax.jit(self._insert_batch_impl,
                                      donate_argnums=(0,))
         self._set_len = jax.jit(
@@ -249,9 +261,11 @@ class LLMEngine:
             return (nxt, cache), (nxt, lp)
 
         rngs = jax.random.split(rng, self.decode_chunk)
-        (_, cache), (toks, lps) = jax.lax.scan(
+        (next_tok, cache), (toks, lps) = jax.lax.scan(
             one_step, (token, cache), rngs)
-        return toks, lps, cache                  # toks/lps: [chunk, B]
+        # next_tok: the device-side carry the pipelined dispatch feeds the
+        # NEXT chunk without waiting for the host to read toks back
+        return toks, lps, next_tok, cache        # toks/lps: [chunk, B]
 
     def _insert_batch_impl(self, cache, k_new, v_new, blk_ids, lengths,
                            slots):
@@ -316,7 +330,10 @@ class LLMEngine:
             return bool(self._waiting or self._active)
 
     def step(self) -> list[GenRequest]:
-        """Admit waiting requests, run one decode step, retire finished.
+        """Admit waiting requests, dispatch one decode chunk, retire
+        finished. Pipelined (default): the dispatch goes out BEFORE the
+        previous chunk's tokens are fetched, so device compute overlaps
+        host transfer + bookkeeping; results therefore lag one chunk.
         Returns requests that finished this step."""
         with self._lock:
             aborted, self._aborted = self._aborted, set()
@@ -327,29 +344,72 @@ class LLMEngine:
                     self.paged.release(slot)
                     self._free.append(slot)
         self._admit()
-        if not self._active:
-            return []
-        active_mask = np.zeros((self.max_batch,), bool)
-        temp = np.zeros((self.max_batch,), np.float32)
-        top_k = np.zeros((self.max_batch,), np.int32)
-        top_p = np.ones((self.max_batch,), np.float32)
-        for slot, req in self._active.items():
-            active_mask[slot] = True
-            temp[slot] = req.sampling.temperature
-            top_k[slot] = req.sampling.top_k
-            top_p[slot] = req.sampling.top_p
-        self._rng, step_rng = jax.random.split(self._rng)
-        toks, lps, self.cache = self._decode(
-            self.params, jnp.asarray(self._tokens), self.cache,
-            jnp.asarray(self.paged.tables),
-            jnp.asarray(active_mask), jnp.asarray(temp),
-            jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
-        toks = np.asarray(toks)                 # [chunk, B]
-        lps = np.asarray(lps)
-        self.steps += toks.shape[0]
+        new_inflight = None
+        if self._active and self._need_dispatch():
+            active_mask = np.zeros((self.max_batch,), bool)
+            temp = np.zeros((self.max_batch,), np.float32)
+            top_k = np.zeros((self.max_batch,), np.int32)
+            top_p = np.ones((self.max_batch,), np.float32)
+            for slot, req in self._active.items():
+                active_mask[slot] = True
+                temp[slot] = req.sampling.temperature
+                top_k[slot] = req.sampling.top_k
+                top_p[slot] = req.sampling.top_p
+            if self._inflight is None or self._fresh.all():
+                token_in = jnp.asarray(self._tokens)
+            else:
+                # device carry from the in-flight chunk; fresh host tokens
+                # (admissions since that dispatch) override their slots
+                token_in = self._merge_tok(
+                    self._inflight["next"], jnp.asarray(self._tokens),
+                    jnp.asarray(self._fresh))
+            self._fresh[:] = False
+            self._rng, step_rng = jax.random.split(self._rng)
+            toks, lps, next_tok, self.cache = self._decode(
+                self.params, token_in, self.cache,
+                jnp.asarray(self.paged.tables),
+                jnp.asarray(active_mask), jnp.asarray(temp),
+                jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
+            new_inflight = {
+                "toks": toks, "lps": lps, "next": next_tok,
+                # snapshot: tokens belong to the requests active at
+                # DISPATCH time — a slot may host a new request by the
+                # time these arrays are read back
+                "snapshot": list(self._active.items()),
+            }
+        prev, self._inflight = self._inflight, new_inflight
+        finished = self._process_chunk(prev) if prev is not None else []
+        if not self.decode_pipeline and self._inflight is not None:
+            # synchronous mode: flush immediately (no overlap, no lag)
+            flush, self._inflight = self._inflight, None
+            finished += self._process_chunk(flush)
+        return finished
 
+    def _need_dispatch(self) -> bool:
+        """Skip the next dispatch when the in-flight chunk already covers
+        every active request's remaining budget — kills the tail-overshoot
+        chunk for uniform max_tokens batches."""
+        if self._inflight is None:
+            return True
+        snapshot_reqs = {id(r) for _, r in self._inflight["snapshot"]}
+        chunk = self.decode_chunk
+        for _, req in self._active.items():
+            if id(req) not in snapshot_reqs:
+                return True            # admitted after the dispatch
+            if (len(req.generated) + chunk < req.sampling.max_tokens
+                    and len(req.prompt) + len(req.generated) + chunk
+                    < self.max_seq):
+                return True            # still needs tokens past the chunk
+        return False
+
+    def _process_chunk(self, inflight: dict) -> list[GenRequest]:
+        toks = np.asarray(inflight["toks"])     # [chunk, B] (blocks here)
+        lps = np.asarray(inflight["lps"])
+        self.steps += toks.shape[0]
         finished = []
-        for slot, req in list(self._active.items()):
+        for slot, req in inflight["snapshot"]:
+            if req.done:
+                continue               # aborted/retired after dispatch
             eos = req.sampling.eos_id
             stop_ids = req.sampling.stop_token_ids
             for t in range(toks.shape[0]):
@@ -361,14 +421,15 @@ class LLMEngine:
                 if (eos is not None and tok == eos) or tok in stop_ids or \
                         len(req.generated) >= req.sampling.max_tokens or \
                         len(req.prompt) + len(req.generated) >= self.max_seq:
-                    # mid-chunk overshoot tokens beyond this point are
-                    # trimmed (never appended); their cache writes went to
-                    # this slot's own blocks / scratch and die with the slot
+                    # overshoot tokens beyond this point are trimmed (never
+                    # appended); their cache writes went to this slot's own
+                    # blocks / scratch and are ordered before any reuse
                     req.done = True
                     finished.append(req)
-                    del self._active[slot]
-                    self.paged.release(slot)
-                    self._free.append(slot)
+                    if self._active.get(slot) is req:
+                        del self._active[slot]
+                        self.paged.release(slot)
+                        self._free.append(slot)
                     break
         return finished
 
@@ -476,7 +537,9 @@ class LLMEngine:
         width = min(self.max_batch, 1 << (len(batch) - 1).bit_length())
         nbmax = bucket // bs
         toks = np.zeros((width, bucket), np.int32)
-        lengths = np.ones((width,), np.int32)       # pad rows: safe index
+        # pad rows: length 0 — prefill masks them out of MoE routing and
+        # clamps its logit-gather index, so they never influence real rows
+        lengths = np.zeros((width,), np.int32)
         blk = np.zeros((width, nbmax), np.int32)
         slots = np.full((width,), -1, np.int32)
         for i, (req, slot, n_shared) in enumerate(batch):
@@ -524,6 +587,7 @@ class LLMEngine:
         self.generated_tokens += 1
         req.slot = slot
         self._tokens[slot] = first_tok
+        self._fresh[slot] = True       # override any device token carry
         self._active[slot] = req
         eos = req.sampling.eos_id
         if (eos is not None and first_tok == eos) or \

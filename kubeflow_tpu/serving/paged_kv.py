@@ -257,12 +257,13 @@ def _layer_qkv(lp, x, positions, cfg, inv_freq):
     return q, k, v
 
 
-def _layer_out(lp, x, o, cfg):
-    """Shared attention-output + FFN path (see _layer_qkv)."""
+def _layer_out(lp, x, o, cfg, token_mask=None):
+    """Shared attention-output + FFN path (see _layer_qkv). token_mask
+    keeps pad/idle rows out of MoE routing (capacity stealing)."""
     o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
     x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    down, _ = llama._ffn(h, lp, cfg)
+    down, _ = llama._ffn(h, lp, cfg, token_mask=token_mask)
     return x + down
 
 
@@ -326,7 +327,9 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
         k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
         v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
         o = decode_attention(q, k_view, v_view, pos + 1)
-        return _layer_out(lp, x, o, cfg), (k_pool, v_pool)
+        # idle slots hold len 0: keep their garbage rows out of MoE routing
+        return _layer_out(lp, x, o, cfg,
+                          token_mask=(pos > 0)[:, None]), (k_pool, v_pool)
 
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
@@ -381,7 +384,8 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
         # the shared GQA causal kernel with traced query offset: row i
         # (absolute position offset+i) attends kv rows <= offset+i
         o = _xla_attention(q, k_view, v_view, causal=True, q_offset=offset)
-        return _layer_out(lp, x, o, cfg), (k_pool, v_pool)
+        return _layer_out(lp, x, o, cfg,
+                          token_mask=valid[None, :]), (k_pool, v_pool)
 
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))

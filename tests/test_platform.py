@@ -443,7 +443,7 @@ def test_render_platform_no_gpu_and_complete():
         kinds.setdefault(d["kind"], []).append(d["metadata"]["name"])
     assert "nvidia" not in text.lower()
     # only daemon-reconciled kinds get CRDs (no orphaned user objects)
-    assert len(kinds["CustomResourceDefinition"]) == 6
+    assert len(kinds["CustomResourceDefinition"]) == 8
     # every Deployment's state PVC is actually rendered
     for dep in kinds["Deployment"]:
         assert f"{dep}-state" in kinds["PersistentVolumeClaim"]

@@ -309,3 +309,46 @@ def test_cross_site_form_posts_rejected(tmp_path):
         assert ("default", "nb") in ui.notebooks.notebooks
     finally:
         op.stop()
+
+
+def test_volumes_page_lists_mounts_and_artifacts(tmp_path):
+    """The pvcviewer role: /ui/volumes lists job volume mounts (namespace
+    -scoped) and pipeline artifact stores; the artifact browser serves
+    directory listings + small text previews and refuses path traversal."""
+    from kubeflow_tpu.pipelines.client import PipelineClient
+    from kubeflow_tpu.pipelines.runner import LocalRunner
+
+    cluster = FakeCluster()
+    jobs = JobController(cluster)
+    job = jax_job("voljob", workers=1, namespace="team-a")
+    job.replica_specs["Worker"].template.volumes = {
+        "ckpts": "/mnt/ckpts", "data": "/mnt/data"}
+    jobs.submit(job)
+
+    client = PipelineClient(LocalRunner(str(tmp_path)))
+    run_dir = tmp_path / "run-1"
+    (run_dir / "sub").mkdir(parents=True)
+    (run_dir / "metrics.json").write_text('{"acc": 0.9}')
+    (run_dir / "sub" / "weights.bin").write_bytes(b"\x00\x01\xff")
+
+    ui = WebUI(jobs=jobs, pipelines=client)
+    page = ui.handle("GET", "/ui/volumes").body
+    assert "voljob" in page and "/mnt/ckpts" in page and "ckpts" in page
+
+    # namespace scoping: a viewer without team-a sees no mounts
+    scoped = ui.handle("GET", "/ui/volumes",
+                       visible=lambda ns: ns != "team-a").body
+    assert "voljob" not in scoped
+
+    listing = ui.handle("GET", "/ui/volumes/artifacts/run-1").body
+    assert "metrics.json" in listing and "sub" in listing
+    preview = ui.handle(
+        "GET", "/ui/volumes/artifacts/run-1/metrics.json").body
+    assert "acc" in preview
+    binary = ui.handle(
+        "GET", "/ui/volumes/artifacts/run-1/sub/weights.bin").body
+    assert "binary" in binary
+    # traversal attempts render as not-found, never escape the run dir
+    for evil in ("/ui/volumes/artifacts/run-1/../_cache",
+                 "/ui/volumes/artifacts/../../etc"):
+        assert "not found" in ui.handle("GET", evil).body
