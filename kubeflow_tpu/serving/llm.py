@@ -79,12 +79,19 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
 
 
-def sample_logits(logits, rng, temperature, top_k, top_p):
+def sample_logits(logits, rng, temperature, top_k, top_p,
+                  greedy_only: bool = False):
     """On-device sampling: greedy when temperature==0, else
     temperature/top-k/top-p. temperature/top_k/top_p are per-batch arrays
-    ([B]); top_k==0 / top_p==1 disable the respective filter."""
+    ([B]); top_k==0 / top_p==1 disable the respective filter.
+
+    ``greedy_only`` (STATIC) skips the full-vocab sort entirely — the
+    sort is O(V log V) bitonic passes on TPU and dominates the decode
+    step for greedy batches, which are the common serving case."""
     vocab = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
+    if greedy_only:
+        return greedy.astype(jnp.int32)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_t[:, None]
 
@@ -228,7 +235,8 @@ class LLMEngine:
                 (tok := sample_logits(logits, rng, t, k, p)),
                 jnp.take_along_axis(logits, tok[:, None], axis=-1)[:, 0]
                 - jax.nn.logsumexp(logits, axis=-1)))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(2,),
+                               static_argnames=("greedy_only",))
         self._merge_tok = jax.jit(
             lambda carry, upd, mask: jnp.where(mask, upd, carry))
         self._insert_batch = jax.jit(self._insert_batch_impl,
@@ -241,14 +249,15 @@ class LLMEngine:
     # ---------------- jitted bodies ----------------
 
     def _decode_impl(self, params, token, cache, tables, active, temperature,
-                     top_k, top_p, rng):
+                     top_k, top_p, rng, greedy_only=False):
         from kubeflow_tpu.serving.paged_kv import paged_decode_step
 
         def one_step(carry, rng_step):
             token, cache = carry
             logits, cache = paged_decode_step(
                 params, token, self.cfg, cache, tables)
-            nxt = sample_logits(logits, rng_step, temperature, top_k, top_p)
+            nxt = sample_logits(logits, rng_step, temperature, top_k,
+                                top_p, greedy_only=greedy_only)
             # chosen-token logprob under the MODEL distribution (OpenAI
             # convention: pre-temperature/filtering). Gather-then-logsumexp
             # rather than materializing the full [B, V] log_softmax.
@@ -369,7 +378,10 @@ class LLMEngine:
                 self.params, token_in, self.cache,
                 jnp.asarray(self.paged.tables),
                 jnp.asarray(active_mask), jnp.asarray(temp),
-                jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
+                jnp.asarray(top_k), jnp.asarray(top_p), step_rng,
+                # static: an all-greedy batch skips the per-step
+                # full-vocab sort (two compile variants total)
+                greedy_only=not bool((temp > 0).any()))
             new_inflight = {
                 "toks": toks, "lps": lps, "next": next_tok,
                 # snapshot: tokens belong to the requests active at

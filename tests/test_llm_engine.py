@@ -648,3 +648,20 @@ def test_pipelined_decode_matches_synchronous(tiny):
     # bf16 ties could in principle differ across batch layouts, but the
     # two modes see identical batch compositions step-for-step here
     assert outs[True] == outs[False]
+
+
+def test_sampled_decode_variant_compiles_and_runs(tiny):
+    """temperature>0 exercises the NON-greedy decode program (the full
+    top-k/top-p sort inside the scan) — the greedy_only static fast path
+    must not be the only variant the suite ever compiles. top_k=1 makes
+    sampling deterministic (argmax survives the filter alone)."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(8,), decode_chunk=3)
+    reqs = eng.generate(
+        [[5, 6, 7], [9, 10]],
+        SamplingParams(max_tokens=6, temperature=0.7, top_k=1))
+    assert all(r.done and len(r.generated) == 6 for r in reqs)
+    # top_k=1 keeps only the argmax: identical to greedy token-for-token
+    for r in reqs:
+        assert_greedy_consistent(params, cfg, r.prompt, r.generated)
