@@ -34,6 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "(KUBERNETES_SERVICE_HOST) when unset")
     serve.add_argument("--kube-image", default="kubeflow-tpu/runtime:latest",
                        help="default worker image for --cluster kube pods")
+    serve.add_argument("--advertise-url", default=None,
+                       help="base URL worker pods reach this daemon at "
+                            "(heartbeat POSTs on --cluster kube); "
+                            "in-cluster: the operator Service DNS")
     serve.add_argument("--config", default=None,
                        help="platform config JSON (the ConfigMap tier); "
                             "flags below override it")
@@ -82,6 +86,14 @@ def main(argv=None) -> int:
 
         from kubeflow_tpu.controller.kube import JobCRStore, KubeCluster
 
+        if not args.advertise_url:
+            # the loopback fallback would have every worker pod POST its
+            # heartbeats to ITSELF — beats black-hole and healthy jobs
+            # gang-restart after the grace window with no diagnostic
+            raise SystemExit(
+                "--cluster kube needs --advertise-url (the URL worker "
+                "pods reach this daemon at, e.g. the operator Service "
+                "DNS http://kft-operator.<ns>:8080)")
         url = args.apiserver
         if url is None:
             host = _os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -175,6 +187,7 @@ def main(argv=None) -> int:
         serving_ticker=serving,
         auth=auth,
         dashboard=dashboard,
+        advertise_url=args.advertise_url,
         webui=WebUI(jobs=controller, experiments=experiments,
                     serving=serving.controller, pipelines=pipelines,
                     notebooks=notebooks, tensorboards=tensorboards),

@@ -103,6 +103,7 @@ class Operator:
         auth=None,
         dashboard=None,
         webui=None,
+        advertise_url: Optional[str] = None,
         pipeline_client=None,
     ):
         self.controller = controller
@@ -170,6 +171,13 @@ class Operator:
         self._submit_times: dict[tuple[str, str], float] = {}
         self._first_step_seen: set[tuple[str, str]] = set()
         self._warn_offsets: dict[str, int] = {}     # warn file -> read pos
+        # heartbeat transport for pods that share no filesystem with this
+        # daemon (KubeCluster): inject an http URL instead of a file path;
+        # the POST handler writes the SAME tracker files locally, keeping
+        # every downstream consumer transport-agnostic. In-cluster installs
+        # pass the operator Service DNS; local dev defaults to the bound
+        # address at start().
+        self.advertise_url = advertise_url
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -184,12 +192,23 @@ class Operator:
                 if prev is not None:
                     pod = prev(pod)
                 job = pod.labels.get("job-name", "")
-                pod.env.setdefault(
-                    "KFT_HEARTBEAT_FILE", self.tracker.path_for(job, pod.name))
-                pod.env.setdefault(
-                    "KFT_WARNING_FILE",
-                    self._warning_path(job, pod.name,
-                                       pod.labels.get("job-uid", "")))
+                if self._pods_share_fs():
+                    pod.env.setdefault(
+                        "KFT_HEARTBEAT_FILE",
+                        self.tracker.path_for(job, pod.name))
+                    pod.env.setdefault(
+                        "KFT_WARNING_FILE",
+                        self._warning_path(job, pod.name,
+                                           pod.labels.get("job-uid", "")))
+                elif self.advertise_url:
+                    # uid-scoped like the file transport: a zombie pod of
+                    # a dead incarnation must not feed the new job
+                    url = (f"{self.advertise_url.rstrip('/')}/apis/v1/"
+                           f"namespaces/{pod.namespace}/jobs/{job}/pods/"
+                           f"{pod.name}/heartbeat"
+                           f"?uid={pod.labels.get('job-uid', '')}")
+                    pod.env.setdefault("KFT_HEARTBEAT_FILE", url)
+                    pod.env.setdefault("KFT_WARNING_FILE", url)
                 return pod
 
             controller.pod_mutator = mutator
@@ -278,6 +297,52 @@ class Operator:
                     self.metrics.inc("kft_heartbeat_stale_total", by=len(stale))
                 self._record_first_step(ns, name)
                 self._collect_warnings(ns, name)
+
+    def _pods_share_fs(self) -> bool:
+        """File heartbeat transport works only when worker pods and this
+        daemon see one filesystem (in-memory/local-process backends).
+        KubeCluster pods live on other nodes — they beat over HTTP."""
+        from kubeflow_tpu.controller.kube import KubeCluster
+
+        return not isinstance(self.controller.cluster, KubeCluster)
+
+    def heartbeat_post(self, ns: str, job_name: str, pod_name: str,
+                       body, uid: str = "") -> bool:
+        """The HTTP heartbeat sink: write the same tracker/warning files
+        the shared-fs transport writes, so staleness sweeps, the
+        first-step metric, and the warning sweep need no second code
+        path. Returns False (dead-lettered) for an unknown job OR a uid
+        that no longer matches — a zombie pod of a deleted incarnation
+        must not feed the job that replaced it. Body is untrusted
+        (unauthenticated route): anything malformed is rejected, never
+        raised."""
+        if self.tracker is None or not isinstance(body, dict):
+            return False
+        job = self.controller.get(ns, job_name)
+        if job is None or (uid and job.uid != uid):
+            return False
+        step = body.get("step")
+        if step is not None:
+            try:
+                step = int(step)
+            except (TypeError, ValueError):
+                return False
+            path = self.tracker.path_for(job_name, pod_name)
+            # unique tmp per writer thread: concurrent beats must not
+            # race each other's os.replace
+            tmp = f"{path}.{threading.get_ident()}.tmp"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(str(step))
+                os.replace(tmp, path)
+            except OSError:
+                return False
+        warning = body.get("warning")
+        if isinstance(warning, dict):
+            with open(self._warning_path(job_name, pod_name, job.uid),
+                      "a") as f:
+                f.write(json.dumps(warning) + "\n")
+        return True
 
     def _warning_path(self, job_name: str, pod_name: str, uid: str) -> str:
         # uid-scoped: a deleted-and-resubmitted job (same names, new uid)
@@ -397,6 +462,10 @@ class Operator:
                 self._httpd.socket, server_side=True,
                 do_handshake_on_connect=False)
         self.port = self._httpd.server_address[1]
+        if self.advertise_url is None:
+            reach = "127.0.0.1" if host in ("0.0.0.0", "::") else host
+            scheme = "https" if tls_cert and tls_key else "http"
+            self.advertise_url = f"{scheme}://{reach}:{self.port}"
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
                          name="kft-http").start()
         return self.port
@@ -540,6 +609,19 @@ def _make_http_server(op: Operator, port: int,
             elif origin == "null":
                 return False
             return True
+
+        def _heartbeat_path(self):
+            # /apis/v1/namespaces/{ns}/jobs/{job}/pods/{pod}/heartbeat[?uid=]
+            from urllib.parse import parse_qs
+
+            route, _, query = self.path.partition("?")
+            parts = route.strip("/").split("/")
+            if (len(parts) == 9 and parts[:3] == ["apis", "v1", "namespaces"]
+                    and parts[4] == "jobs" and parts[6] == "pods"
+                    and parts[8] == "heartbeat"):
+                uid = (parse_qs(query).get("uid") or [""])[0]
+                return parts[3], parts[5], parts[7], uid
+            return None
 
         def _resource_path(self, kind: str):
             # /apis/v1/namespaces/{ns}/{kind}[/{name}]
@@ -702,6 +784,21 @@ def _make_http_server(op: Operator, port: int,
                 # text-plain form posts need no preflight)
                 return self._send(
                     403, '{"error": "cross-site request rejected"}')
+            hb = self._heartbeat_path()
+            if hb is not None:
+                # worker liveness sink — UNAUTHENTICATED by design: worker
+                # pods hold no bearer tokens, and forging a beat only
+                # delays fault detection (same trust level as the shared
+                # -fs file transport it replaces); warnings are advisory
+                try:
+                    body_doc = json.loads(raw.decode() or "{}")
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    return self._send(400, '{"error": "bad json"}')
+                ns_, job_, pod_, uid_ = hb
+                ok = op.heartbeat_post(ns_, job_, pod_, body_doc, uid=uid_)
+                return self._send(200 if ok else 404,
+                                  '{"ok": true}' if ok
+                                  else '{"error": "unknown job or uid"}')
             if not self._authorized():
                 return
             # proxy BEFORE decoding: inference payloads may be binary

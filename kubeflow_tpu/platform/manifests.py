@@ -47,7 +47,10 @@ PLATFORM_IMAGE = "kubeflow-tpu/platform:latest"
 OPERATOR_ARGS = ["serve", "--config", "/etc/kft/platform.json",
                  "--state-dir", "/data",
                  "--auth-tokens", "/etc/kft/auth.json",
-                 "--bind-host", "0.0.0.0", "--port", "8080"]
+                 "--bind-host", "0.0.0.0", "--port", "8080",
+                 # worker pods beat liveness back over HTTP (no shared fs
+                 # on a real cluster): the operator Service DNS name
+                 "--advertise-url", "http://kft-operator.kubeflow-tpu:8080"]
 CONTROLLERS = [
     # (name, image, command, args, port, probe)
     ("kft-operator", PLATFORM_IMAGE,
@@ -185,12 +188,12 @@ def metadata_store_network_policy(namespace: str = "kubeflow-tpu") -> dict:
 
 
 def pvc(name: str, namespace: str = "kubeflow-tpu",
-        size: str = "10Gi") -> dict:
+        size: str = "10Gi", access: str = "ReadWriteOnce") -> dict:
     return {
         "apiVersion": "v1",
         "kind": "PersistentVolumeClaim",
         "metadata": {"name": name, "namespace": namespace},
-        "spec": {"accessModes": ["ReadWriteOnce"],
+        "spec": {"accessModes": [access],
                  "resources": {"requests": {"storage": size}}},
     }
 

@@ -41,12 +41,20 @@ def _default_mirror_alarm(exc: Exception) -> None:
         return
     import json
     import time
+    rec = {
+        "ts": time.time(),
+        "reason": "CheckpointMirrorDegraded",
+        "message": f"{type(exc).__name__}: {exc}",
+    }
+    if path.startswith(("http://", "https://")):
+        # KubeCluster transport: the shared heartbeat-POST helper (no
+        # shared filesystem between pods and the operator)
+        from kubeflow_tpu.training.loop import post_heartbeat
+
+        post_heartbeat(path, warning=rec)
+        return
     with open(path, "a") as f:
-        f.write(json.dumps({
-            "ts": time.time(),
-            "reason": "CheckpointMirrorDegraded",
-            "message": f"{type(exc).__name__}: {exc}",
-        }) + "\n")
+        f.write(json.dumps(rec) + "\n")
 
 
 def _is_remote(path: str) -> bool:

@@ -29,20 +29,97 @@ class FitResult:
     last_metrics: dict
 
 
+def post_heartbeat(url: str, step=None, warning=None,
+                   timeout: float = 5.0) -> bool:
+    """ONE http transport for the heartbeat contract (beats + warnings;
+    loop.Heartbeat and checkpoint's mirror alarm both route through
+    here). Failures are swallowed: missed beats ARE the failure signal."""
+    import json
+    import urllib.request
+
+    body: dict = {}
+    if step is not None:
+        body["step"] = int(step)
+    if warning is not None:
+        body["warning"] = warning
+    try:
+        req = urllib.request.Request(
+            url, method="POST", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=timeout).close()
+        return True
+    except Exception:
+        return False
+
+
 class Heartbeat:
-    """Liveness file: mtime is the signal, content is the last step. The
-    controller-side FileHeartbeatTracker reads these (SURVEY.md §2.8 fault
-    signaling: heartbeat loss => job-level restart)."""
+    """Liveness signal: the controller-side FileHeartbeatTracker turns
+    missed beats into gang restarts (SURVEY.md §2.8 fault signaling).
 
-    def __init__(self, path: str):
+    Two transports behind ONE env value (KFT_HEARTBEAT_FILE):
+    - a filesystem path (LocalProcessCluster: shared fs) — mtime is the
+      signal, content is the last step;
+    - an http(s) URL (KubeCluster: pods and operator share no
+      filesystem) — beats POST to the operator's heartbeat route, which
+      writes the same tracker file on ITS side, so every downstream
+      consumer (staleness sweep, first-step metric, warning sweep) is
+      transport-agnostic. URL beats post from a BACKGROUND thread
+      holding only the latest step (rate-limited), so a slow or down
+      operator can never stall the training hot loop.
+    """
+
+    def __init__(self, path: str, min_interval_s: float = 1.0):
         self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.is_url = path.startswith(("http://", "https://"))
+        self.min_interval_s = min_interval_s
+        if self.is_url:
+            import queue
+            import threading
 
-    def beat(self, step: int) -> None:
+            self._latest: Optional[int] = None
+            self._warnings: "queue.Queue[dict]" = queue.Queue()
+            self._kick = threading.Event()
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._pump, daemon=True, name="kft-heartbeat-post")
+            self._thread.start()
+        else:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def beat(self, step: int, warning: Optional[dict] = None) -> None:
+        if self.is_url:
+            self._latest = int(step)
+            if warning is not None:
+                self._warnings.put(warning)
+            self._kick.set()
+            return
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             f.write(str(step))
         os.replace(tmp, self.path)
+
+    def _pump(self) -> None:
+        import queue
+
+        while not self._stop.is_set():
+            self._kick.wait()
+            self._kick.clear()
+            step, self._latest = self._latest, None
+            warning = None
+            try:
+                warning = self._warnings.get_nowait()
+            except queue.Empty:
+                pass
+            if step is not None or warning is not None:
+                post_heartbeat(self.path, step=step, warning=warning)
+            if not self._warnings.empty() or self._latest is not None:
+                self._kick.set()       # drain remaining work next loop
+            self._stop.wait(self.min_interval_s)   # rate limit
+
+    def close(self) -> None:
+        if self.is_url:
+            self._stop.set()
+            self._kick.set()
 
 
 def fit(

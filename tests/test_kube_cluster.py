@@ -295,6 +295,7 @@ def test_operator_daemon_drives_kube_backend(apiserver, tmp_path):
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.controller", "serve",
          "--cluster", "kube", "--apiserver", apiserver.url,
+         "--advertise-url", "http://127.0.0.1:0",
          "--port", "0", "--reconcile-period", "0.1",
          "--state-dir", str(tmp_path / "state"),
          "--heartbeat-dir", str(tmp_path / "hb")],
@@ -458,3 +459,63 @@ def test_submit_ignores_client_supplied_uid(kube):
     ctl.delete("default", "fresh-uid")
     again = ctl.submit(from_yaml(exported))
     assert again.uid and again.uid != old_uid
+
+
+
+def test_http_heartbeat_contract_over_kube_backend(apiserver, tmp_path):
+    """On a real cluster, pods and the operator share no filesystem: the
+    operator injects an http heartbeat URL (not a file path), workers
+    POST beats/warnings to it, and the SAME tracker machinery consumes
+    them (first-step metric, staleness sweep, warning conditions)."""
+    import urllib.request
+
+    from kubeflow_tpu.controller import Operator
+    from kubeflow_tpu.training.loop import Heartbeat
+
+    kube = KubeCluster(apiserver.url)
+    ctl = JobController(kube)
+    op = Operator(ctl, heartbeat_dir=str(tmp_path / "hb"),
+                  reconcile_period=0.05, heartbeat_period=0.1)
+    op.start(port=0)
+    try:
+        job = jax_job("hb-kube", workers=1, mesh={"data": 1})
+        op.submit(job)
+        ctl.reconcile("default", "hb-kube")
+        pod = kube.list_pods("default", {"job-name": "hb-kube"})[0]
+        url = pod.env["KFT_HEARTBEAT_FILE"]
+        assert url.startswith("http://"), url
+        assert pod.env["KFT_WARNING_FILE"] == url
+        kube.run_scheduled()
+
+        # the worker side: training.loop.Heartbeat speaks both transports
+        hb = Heartbeat(url)
+        hb.beat(1)
+        hb.beat(2, warning={"reason": "CheckpointMirrorDegraded",
+                            "message": "bucket gone"})
+        # first-step metric + warning condition appear via the normal sweeps
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            lat = op.metrics.get(
+                "kft_submit_to_first_step_seconds",
+                {"namespace": "default", "job": "hb-kube"})
+            warns = ctl.get("default", "hb-kube").status.warnings()
+            if lat is not None and warns:
+                break
+            time.sleep(0.1)
+        assert lat is not None
+        assert warns and warns[0].reason == "CheckpointMirrorDegraded"
+        # tracker staleness: the beat file exists operator-side
+        assert not op.tracker.is_stale("hb-kube", pod.name,
+                                       pod.created_at)
+        # unknown job dead-letters with 404
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{op.port}/apis/v1/namespaces/default/jobs/"
+            "nope/pods/x/heartbeat", method="POST", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bad, timeout=5)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        op.stop()
