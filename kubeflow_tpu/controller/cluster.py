@@ -49,6 +49,11 @@ class Pod:
     exit_code: Optional[int] = None
     node: Optional[str] = None
     scheduled: bool = False            # gang admission happened
+    # gang-scheduled pods carry a scheduling gate on real backends until the
+    # whole slice group is admitted (the job reconciler's whole-slice atom);
+    # Deployment-style pods (serving/notebook/tensorboard) never gate — they
+    # schedule individually the moment they are admitted
+    gang: bool = False
     created_at: float = dataclasses.field(default_factory=time.time)
     # real-cluster placement (rendered by the KubeCluster backend; ignored
     # by in-memory/local-process backends): container image, GKE TPU
@@ -77,6 +82,32 @@ class Cluster(Protocol):
     def resolve(self, namespace: str, service: str) -> str:
         """DNS-equivalent: service name -> address workers can dial."""
         ...
+
+
+def admit_pod(cluster: Cluster, pod: Pod) -> None:
+    """Admit a pod: mark it schedulable and invoke the backend's start hook
+    where one exists — LocalProcessCluster launches the process, KubeCluster
+    lifts the gang gate (gang pods) and publishes late-bound env,
+    FakeCluster has no hook (tests play kubelet via
+    set_phase/run_scheduled). Both the job reconciler (post-gang-admission)
+    and the Deployment-style controllers (serving/notebook/tensorboard,
+    no gang barrier) route through this one contract."""
+    pod.scheduled = True
+    start = getattr(cluster, "start_pod", None)
+    if start is not None:
+        start(pod)
+
+
+def create_and_admit(cluster: Cluster, pod: Pod) -> None:
+    """Deployment-style pod creation: create + immediately admit. A lost
+    create race (another reconcile pass — or, on kube, a lagging informer
+    briefly hiding a live pod — already made it) adopts instead of
+    raising: the pod exists, which is all the caller wanted."""
+    try:
+        cluster.create_pod(pod)
+    except KeyError:
+        return
+    admit_pod(cluster, pod)
 
 
 class FakeCluster:
@@ -153,6 +184,7 @@ class LocalProcessCluster:
         self.ports: dict[tuple[str, str], int] = {}
         self.log_dir = log_dir
         self._lock = threading.Lock()   # pods/procs dicts vs async init
+        self._starting: set[tuple[str, str]] = set()   # start_pod in flight
         os.makedirs(log_dir, exist_ok=True)
 
     def create_pod(self, pod: Pod) -> None:
@@ -162,19 +194,39 @@ class LocalProcessCluster:
         self.pods[key] = pod
 
     def start_pod(self, pod: Pod) -> None:
-        """Launch the process (called once the pod is gang-scheduled)."""
+        """Launch the process (called at admission). Idempotent: a pod whose
+        process (or init step) is already launched is left alone — repeated
+        reconcile passes admit the same pod more than once."""
         key = (pod.namespace, pod.name)
+        with self._lock:
+            if pod.phase != PodPhase.PENDING:
+                return      # terminal pods restart via delete+recreate only
+            if key in self.procs or key in self.init_procs \
+                    or key in self._starting:
+                return
+            self._starting.add(key)
         env = dict(os.environ)
         env.update(pod.env)
         log = open(os.path.join(self.log_dir, f"{pod.name}.log"), "wb")
 
         def _launch():
-            # caller holds self._lock (or no init thread exists yet)
-            proc = subprocess.Popen(
-                pod.command or [sys.executable, "-c", "pass"],
-                env=env, stdout=log, stderr=subprocess.STDOUT,
-            )
+            # caller holds self._lock (or no init thread exists yet).
+            # A failed spawn (bad command, ENOMEM) marks the pod FAILED —
+            # never leaves it wedged Pending with a stuck _starting entry
+            try:
+                proc = subprocess.Popen(
+                    pod.command or [sys.executable, "-c", "pass"],
+                    env=env, stdout=log, stderr=subprocess.STDOUT,
+                )
+            except OSError as e:
+                self._starting.discard(key)
+                pod.phase = PodPhase.FAILED
+                pod.exit_code = -1
+                log.write(f"spawn failed: {e}\n".encode())
+                log.close()
+                return
             self.procs[key] = proc
+            self._starting.discard(key)     # outcome recorded in procs
             pod.phase = PodPhase.RUNNING
             pod.node = "localhost"
 
@@ -185,15 +237,26 @@ class LocalProcessCluster:
             # the race with delete_pod: a deleted pod's init is killed and
             # its main command never launches.
             def _init_then_launch():
-                init = subprocess.Popen(
-                    pod.init_command, env=env, stdout=log,
-                    stderr=subprocess.STDOUT)
+                try:
+                    init = subprocess.Popen(
+                        pod.init_command, env=env, stdout=log,
+                        stderr=subprocess.STDOUT)
+                except OSError as e:
+                    with self._lock:
+                        self._starting.discard(key)
+                        pod.phase = PodPhase.FAILED
+                        pod.exit_code = -1
+                        log.write(f"init spawn failed: {e}\n".encode())
+                        log.close()
+                    return
                 with self._lock:
                     if key not in self.pods:
                         init.kill()
                         log.close()
+                        self._starting.discard(key)
                         return
                     self.init_procs[key] = init
+                    self._starting.discard(key)  # in-flight now visible
                 rc = init.wait()
                 with self._lock:
                     self.init_procs.pop(key, None)
@@ -218,6 +281,7 @@ class LocalProcessCluster:
             init = self.init_procs.pop(key, None)
             proc = self.procs.pop(key, None)
             self.pods.pop(key, None)
+            self._starting.discard(key)
         if init and init.poll() is None:
             init.kill()
         if proc and proc.poll() is None:

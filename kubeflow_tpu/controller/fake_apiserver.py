@@ -95,11 +95,17 @@ class FakeKubeApiServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
+        # wire-level accounting for informer tests: how many LIST (GET
+        # collection), GET (single object), and WATCH requests arrived.
+        # The informer architecture's whole point is that steady-state
+        # reads hit the cache, not the server — these counters prove it.
+        self.requests: dict[str, int] = {"LIST": 0, "GET": 0, "WATCH": 0}
 
     # ------------------------------------------------------------ http --
 
     def start(self) -> "FakeKubeApiServer":
         store = self.store
+        srv = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -161,7 +167,10 @@ class FakeKubeApiServer:
                 if r is None:
                     return self._err(404, "NotFound", self.path)
                 resource, ns, name, _sub, q = r
+                kind = ("WATCH" if q.get("watch") == "true"
+                        else "GET" if name else "LIST")
                 with store.lock:
+                    srv.requests[kind] = srv.requests.get(kind, 0) + 1
                     if name:
                         obj = store.objects.get((resource, ns, name))
                         if obj is None:

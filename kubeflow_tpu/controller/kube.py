@@ -41,6 +41,7 @@ import json
 import os
 import ssl
 import threading
+import time
 from typing import Iterator, Optional
 from urllib.parse import quote, urlparse
 
@@ -103,7 +104,6 @@ def pod_to_manifest(pod: Pod, image: str) -> dict:
         container["command"] = list(pod.command)
     spec = {
         "restartPolicy": "Never",      # restarts are the controller's call
-        "schedulingGates": [{"name": GANG_GATE}],
         "containers": [container],
         # late-bound admission values surface in-container through the
         # downward API (annotations stay mutable; pod env does not)
@@ -111,6 +111,13 @@ def pod_to_manifest(pod: Pod, image: str) -> dict:
             {"path": "annotations",
              "fieldRef": {"fieldPath": "metadata.annotations"}}]}}],
     }
+    if pod.gang:
+        # only gang-scheduled (job) pods are gated: the kube-scheduler must
+        # not place any slice member before the whole group is admitted, and
+        # the gate doubles as the late-bound-env latch (KFT_SLICE_ID lands
+        # as annotations before the container can start). Serving/notebook
+        # pods schedule individually and immediately.
+        spec["schedulingGates"] = [{"name": GANG_GATE}]
     if pod.node_selector:
         spec["nodeSelector"] = dict(pod.node_selector)
     if pod.init_command:
@@ -182,6 +189,15 @@ class KubeCluster:
         self._services: dict[tuple[str, str], Service] = {}
         self._informer: Optional[threading.Thread] = None
         self._informer_stop = threading.Event()
+        # informer-cache mode (the client-go architecture): while a
+        # selector-free informer runs, get_pod/list_pods serve from the
+        # watch-fed cache — zero REST requests between pod events; the
+        # informer thread itself repairs drift with a periodic resync LIST
+        self._cache_serving = False
+        self._cache_namespace = ""          # "" = cluster-wide
+        # called (event_type, pod) after each folded watch event — the
+        # daemon hangs its reconcile wakeup here
+        self.on_pod_event = None
 
     # ------------------------------------------------------------ http --
 
@@ -219,7 +235,10 @@ class KubeCluster:
 
     @staticmethod
     def _pod_path(ns: str, name: str = "", sub: str = "") -> str:
-        p = f"/api/v1/namespaces/{quote(ns)}/pods"
+        # ns "" = cluster scope (/api/v1/pods): the informer's all-namespace
+        # list+watch; named-pod verbs always carry a namespace
+        p = (f"/api/v1/namespaces/{quote(ns)}/pods" if ns
+             else "/api/v1/pods")
         if name:
             p += f"/{quote(name)}"
         if sub:
@@ -232,15 +251,21 @@ class KubeCluster:
         key = (pod.namespace, pod.name)
         manifest = pod_to_manifest(pod, self.image)
         try:
-            self._request("POST", self._pod_path(pod.namespace),
-                          manifest)
+            doc = self._request("POST", self._pod_path(pod.namespace),
+                                manifest)
         except KubeApiError as e:
             if e.code == 409:
                 raise KeyError(f"pod {key} exists") from e
             raise
         with self._lock:
+            try:
+                pod._rv = int(  # noqa: SLF001 — incarnation fencing
+                    (doc.get("metadata") or {}).get("resourceVersion", 0))
+            except (TypeError, ValueError):
+                pod._rv = 0
             self._pods[key] = pod
-            self._gated.add(key)
+            if pod.gang:
+                self._gated.add(key)
             self._pushed_env[key] = dict(pod.env)
 
     def start_pod(self, pod: Pod) -> None:
@@ -279,6 +304,12 @@ class KubeCluster:
             self._pushed_env.pop(key, None)
 
     def _apply_remote(self, pod: Pod, doc: dict) -> None:
+        try:
+            rv = int((doc.get("metadata") or {})
+                     .get("resourceVersion", 0) or 0)
+            pod._rv = max(getattr(pod, "_rv", 0), rv)
+        except (TypeError, ValueError):
+            pass
         phase, exit_code = _manifest_status(doc)
         gates = (doc.get("spec", {}) or {}).get("schedulingGates") or []
         if not gates:
@@ -297,16 +328,15 @@ class KubeCluster:
         if node:
             pod.node = node
 
-    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
-        key = (namespace, name)
-        try:
-            doc = self._request("GET", self._pod_path(namespace, name))
-        except KubeApiError as e:
-            if e.code == 404:
-                with self._lock:
-                    self._pods.pop(key, None)
-                return None
-            raise
+    def _cache_covers(self, namespace: str) -> bool:
+        return self._cache_serving and (
+            not self._cache_namespace or self._cache_namespace == namespace)
+
+    def _fold(self, doc: dict) -> Pod:
+        """Merge a server manifest into the informer cache (caller need
+        not hold the lock)."""
+        key = (doc["metadata"].get("namespace") or "default",
+               doc["metadata"]["name"])
         with self._lock:
             pod = self._pods.get(key)
             if pod is None:
@@ -315,32 +345,53 @@ class KubeCluster:
             self._apply_remote(pod, doc)
             return pod
 
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        key = (namespace, name)
+        if self._cache_covers(namespace):
+            with self._lock:
+                return self._pods.get(key)
+        try:
+            doc = self._request("GET", self._pod_path(namespace, name))
+        except KubeApiError as e:
+            if e.code == 404:
+                with self._lock:
+                    self._pods.pop(key, None)
+                return None
+            raise
+        return self._fold(doc)
+
     def list_pods(self, namespace: str,
                   selector: dict[str, str]) -> list[Pod]:
+        if self._cache_covers(namespace):
+            with self._lock:
+                return [p for (ns, _), p in self._pods.items()
+                        if ns == namespace
+                        and all(p.labels.get(k) == v
+                                for k, v in selector.items())]
+        return self._list_pods_rest(namespace, selector)
+
+    def _list_pods_rest(self, namespace: str,
+                        selector: dict[str, str]) -> list[Pod]:
+        t0 = time.time()
         sel = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
         path = self._pod_path(namespace)
         if sel:
             path += f"?labelSelector={quote(sel)}"
         docs = self._request("GET", path).get("items", [])
-        out = []
+        out = [self._fold(doc) for doc in docs]
         with self._lock:
-            remote = set()
-            for doc in docs:
-                name = doc["metadata"]["name"]
-                key = (namespace, name)
-                remote.add(key)
-                pod = self._pods.get(key)
-                if pod is None:
-                    pod = self._pod_from_manifest(doc)
-                    self._pods[key] = pod
-                self._apply_remote(pod, doc)
-                out.append(pod)
-            # reap cache entries whose pods vanished server-side
+            remote = {(p.namespace, p.name) for p in out}
+            # reap cache entries whose pods vanished server-side; skip pods
+            # created after the LIST left (a POST racing the resync must
+            # not evict its own fresh cache entry)
             for key in [k for k, p in self._pods.items()
-                        if k[0] == namespace and k not in remote
+                        if (not namespace or k[0] == namespace)
+                        and k not in remote and p.created_at < t0
                         and all(p.labels.get(lk) == lv
                                 for lk, lv in selector.items())]:
                 self._pods.pop(key, None)
+                self._gated.discard(key)
+                self._pushed_env.pop(key, None)
         return out
 
     def _pod_from_manifest(self, doc: dict) -> Pod:
@@ -362,6 +413,7 @@ class KubeCluster:
                 or []),
         )
         pod.scheduled = not spec.get("schedulingGates")
+        pod.gang = bool(spec.get("schedulingGates"))
         # adoption bookkeeping: what the server already has needs no push
         self._pushed_env[(pod.namespace, pod.name)] = dict(env)
         return pod
@@ -481,7 +533,18 @@ class KubeCluster:
                     with self._lock:
                         pod = self._pods.get(key)
                         if event["type"] == "DELETED":
-                            self._pods.pop(key, None)
+                            # incarnation fence: a lagging DELETED for an
+                            # old same-name pod must not evict a freshly
+                            # re-created one (its rv is newer than the
+                            # deletion event's)
+                            try:
+                                ev_rv = int(doc["metadata"].get(
+                                    "resourceVersion", 0) or 0)
+                            except (TypeError, ValueError):
+                                ev_rv = 0
+                            if pod is None or \
+                                    getattr(pod, "_rv", 0) <= ev_rv:
+                                self._pods.pop(key, None)
                             if pod is None:
                                 pod = self._pod_from_manifest(doc)
                         else:
@@ -493,33 +556,76 @@ class KubeCluster:
         finally:
             conn.close()
 
-    def start_informer(self, namespace: str,
-                       selector: dict[str, str] = {}) -> None:
-        """Background watch keeping the cache fresh between reconciles."""
+    def start_informer(self, namespace: str = "",
+                       selector: dict[str, str] = {},
+                       resync_period_s: float = 30.0) -> None:
+        """List+watch informer (the client-go reflector role): one priming
+        LIST, then a background watch keeps the cache fresh. With an empty
+        selector, get_pod/list_pods switch to cache-serving — steady-state
+        reconciles issue ZERO apiserver reads between pod events; a resync
+        LIST every ``resync_period_s`` repairs any drift. ``on_pod_event``
+        (if set) fires after each folded event so the daemon can reconcile
+        on events instead of polling."""
         if self._informer is not None:
             return
+        self._cache_namespace = namespace
+        self._list_pods_rest(namespace, dict(selector))     # prime
+        if not selector:
+            self._cache_serving = True
 
         def loop():
-            while not self._informer_stop.is_set():
-                try:
-                    for _ in self.watch_pods(
-                            namespace, selector, timeout_s=10,
-                            from_rv=getattr(self, "_watch_rv", 0)):
-                        if self._informer_stop.is_set():
+            try:
+                last_resync = time.monotonic()
+                while not self._informer_stop.is_set():
+                    try:
+                        for etype, pod in self.watch_pods(
+                                namespace, selector, timeout_s=10,
+                                from_rv=getattr(self, "_watch_rv", 0)):
+                            if self._informer_stop.is_set():
+                                return
+                            cb = self.on_pod_event
+                            if cb is not None:
+                                try:
+                                    cb(etype, pod)
+                                except Exception:
+                                    pass
+                    except Exception:
+                        if self._informer_stop.wait(1.0):
                             return
-                except Exception:
-                    if self._informer_stop.wait(1.0):
-                        return
+                    if time.monotonic() - last_resync >= resync_period_s:
+                        last_resync = time.monotonic()
+                        try:
+                            self._list_pods_rest(namespace, dict(selector))
+                        except Exception:
+                            pass
+            finally:
+                # self-deregister: if stop_informer timed out waiting on a
+                # blocked watch read, this (eventual) exit is what frees
+                # the slot for a future start_informer
+                with self._lock:
+                    if self._informer is threading.current_thread():
+                        self._informer = None
+                        self._informer_stop.clear()
 
         self._informer = threading.Thread(
             target=loop, daemon=True, name="kube-informer")
         self._informer.start()
 
+    @property
+    def informer_running(self) -> bool:
+        return self._informer is not None
+
     def stop_informer(self) -> None:
         self._informer_stop.set()
-        if self._informer is not None:
-            self._informer.join(timeout=15)
-            self._informer = None
+        self._cache_serving = False
+        t = self._informer
+        if t is not None:
+            t.join(timeout=15)
+            # if still blocked in a watch read (socket timeout can be
+            # ~20s), leave the stop flag SET — the loop's finally block
+            # deregisters and clears it when the read finally returns;
+            # clearing here would un-stop the thread
+            return
         self._informer_stop.clear()
 
     # ------------------------------------------------ generic install --
@@ -584,11 +690,14 @@ class KubeCluster:
             status["containerStatuses"] = [{
                 "name": "worker",
                 "state": {"terminated": {"exitCode": int(exit_code)}}}]
-        self._request(
+        doc = self._request(
             "PATCH", self._pod_path(namespace, name, "status"),
             {"status": status},
             content_type="application/merge-patch+json")
-        self.get_pod(namespace, name)      # fold into the cache now
+        # fold into the cache now (direct, not via get_pod: with the
+        # informer cache serving reads, get_pod would not refetch)
+        if doc:
+            self._fold(doc)
 
     def run_scheduled(self) -> None:
         """Pretend kubelet: every gate-lifted Pending pod goes Running."""

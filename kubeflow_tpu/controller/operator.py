@@ -96,6 +96,8 @@ class Operator:
         startup_grace_s: float = 300.0,
         reconcile_period: float = 0.25,
         heartbeat_period: float = 1.0,
+        reconcile_slow_period: float = 5.0,
+        informer_resync_s: float = 30.0,
         serving_tickers: tuple = (),
         serving_period: float = 1.0,
         experiment_manager=None,
@@ -166,6 +168,12 @@ class Operator:
         )
         self.reconcile_period = reconcile_period
         self.heartbeat_period = heartbeat_period
+        # informer mode (kube backend): reconcile wakes on pod events and
+        # otherwise idles at the slow period — no 0.25s LIST storm against
+        # a real apiserver (the client-go informer architecture)
+        self.reconcile_slow_period = reconcile_slow_period
+        self.informer_resync_s = informer_resync_s
+        self._pod_event_wake: Optional[threading.Event] = None
         self.serving_tickers = tuple(serving_tickers)
         self.serving_period = serving_period
         self._submit_times: dict[tuple[str, str], float] = {}
@@ -247,15 +255,31 @@ class Operator:
             self.controller.submit(job)
             self._submit_times[(job.namespace, job.name)] = time.time()
         self.metrics.inc("kft_jobs_submitted_total")
+        if self._pod_event_wake is not None:
+            self._pod_event_wake.set()       # reconcile now, not next tick
 
     def delete(self, ns: str, name: str) -> None:
         with self._lock:
             self.controller.delete(ns, name)
+        if self._pod_event_wake is not None:
+            self._pod_event_wake.set()
 
     # ---------------- loops ----------------
 
+    def _wait_reconcile(self) -> bool:
+        """Block until the next reconcile pass is due; True = stopping.
+        Poll-driven on in-memory/local backends; on an informer backend,
+        wake immediately on any pod event and otherwise idle at the slow
+        period (job-level timers — active deadlines, restart backoff —
+        still get evaluated each slow tick)."""
+        if self._pod_event_wake is None:
+            return self._stop.wait(self.reconcile_period)
+        if self._pod_event_wake.wait(timeout=self.reconcile_slow_period):
+            self._pod_event_wake.clear()
+        return self._stop.is_set()
+
     def _reconcile_loop(self):
-        while not self._stop.wait(self.reconcile_period):
+        while not self._wait_reconcile():
             keys = list(self.controller.jobs.keys())
             self.metrics.set("kft_jobs_registered", len(keys))
             pending = 0
@@ -319,7 +343,10 @@ class Operator:
         if self.tracker is None or not isinstance(body, dict):
             return False
         job = self.controller.get(ns, job_name)
-        if job is None or (uid and job.uid != uid):
+        # uid is REQUIRED to match: injected heartbeat URLs always carry
+        # ?uid=, so a beat without one is a forged/stale client — accepting
+        # it would let a replaced incarnation's zombie feed this tracker
+        if job is None or job.uid != uid:
             return False
         step = body.get("step")
         if step is not None:
@@ -436,6 +463,14 @@ class Operator:
         reach the API; the default stays loopback for local dev. With
         ``tls_cert``/``tls_key`` the API serves HTTPS (the cert-manager
         serving-cert role; see platform.certs.ensure_self_signed)."""
+        cluster = self.controller.cluster
+        if hasattr(cluster, "start_informer"):
+            # kube backend: watch-fed cache serves every read between pod
+            # events, and events (not a poll timer) drive reconcile
+            self._pod_event_wake = threading.Event()
+            cluster.on_pod_event = (
+                lambda etype, pod: self._pod_event_wake.set())
+            cluster.start_informer(resync_period_s=self.informer_resync_s)
         self._threads = [
             threading.Thread(target=self._reconcile_loop, daemon=True,
                              name="kft-reconcile"),
@@ -472,6 +507,12 @@ class Operator:
 
     def stop(self):
         self._stop.set()
+        if self._pod_event_wake is not None:
+            self._pod_event_wake.set()       # unblock the reconcile wait
+        stop_informer = getattr(self.controller.cluster,
+                                "stop_informer", None)
+        if stop_informer is not None:
+            stop_informer()
         if self._httpd is not None:
             self._httpd.shutdown()
         for t in self._threads:
@@ -596,7 +637,15 @@ def _make_http_server(op: Operator, port: int,
             could fire drive-by POSTs at a localhost daemon. Browsers stamp
             cross-origin form posts with ``Sec-Fetch-Site: cross-site``
             and an ``Origin`` header; header-less clients (curl, the test
-            suite, the SDK) are same-machine tools and pass."""
+            suite, the SDK) are same-machine tools and pass. A request
+            carrying a bearer token that authenticates is exempt: browsers
+            attach Origin/Sec-Fetch-Site to legitimate cross-origin
+            authenticated fetch() too, and the token itself already
+            defeats CSRF (an attacker page cannot read it)."""
+            authz = self.headers.get("Authorization")
+            if authz and op.auth is not None \
+                    and op.auth.authenticate(authz) is not None:
+                return True
             sfs = self.headers.get("Sec-Fetch-Site")
             if sfs is not None and sfs not in (
                     "same-origin", "same-site", "none"):

@@ -27,7 +27,7 @@ from kubeflow_tpu.api.types import (
     ReplicaType, RestartPolicy, validate,
 )
 from kubeflow_tpu.controller.cluster import (
-    Cluster, LocalProcessCluster, Pod, PodPhase, Service,
+    Cluster, LocalProcessCluster, Pod, PodPhase, Service, admit_pod,
 )
 from kubeflow_tpu.controller.gang import GangScheduler, PodGroup
 
@@ -222,10 +222,24 @@ class JobController:
                         resources={
                             "google.com/tpu": str(tpu.chips_per_host),
                         } if tpu is not None else {},
+                        # job pods are gang-gated on real backends until
+                        # _start_admitted lifts the gate (the gate also
+                        # latches late-bound env like KFT_SLICE_ID); this
+                        # covers non-gang jobs too — admission happens in
+                        # the same reconcile pass, and the latch guarantees
+                        # the env annotations land before the container runs
+                        gang=True,
                     )
                     if self.pod_mutator is not None:
                         pod = self.pod_mutator(pod)
-                    self.cluster.create_pod(pod)
+                    try:
+                        self.cluster.create_pod(pod)
+                    except KeyError:
+                        # lost a create race (event-driven reconcile can
+                        # overlap an API-thread reconcile; on kube, a
+                        # lagging informer can also briefly hide a live
+                        # pod): the pod exists — adopt it next read
+                        continue
 
     def _start_admitted(self, job: JobSpec) -> None:
         admitted = (
@@ -254,13 +268,10 @@ class JobController:
                 pod.env.setdefault("KFT_SLICE_ID", sid)
         for pod in pods:
             if pod.phase == PodPhase.PENDING and not pod.scheduled:
-                pod.scheduled = True
                 # backend's admission hook: LocalProcessCluster launches the
                 # process; KubeCluster lifts the scheduling gate + publishes
                 # late-bound env; FakeCluster has none (tests play kubelet)
-                start = getattr(self.cluster, "start_pod", None)
-                if start is not None:
-                    start(pod)
+                admit_pod(self.cluster, pod)
 
     def cluster_env(self, job: JobSpec, rtype: str, index: int) -> dict[str, str]:
         """Per-kind rendezvous env (the reference's SetClusterSpec equivalent)."""

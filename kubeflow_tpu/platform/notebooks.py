@@ -7,7 +7,9 @@ import dataclasses
 import time
 from typing import Optional
 
-from kubeflow_tpu.controller.cluster import Cluster, Pod, Service
+from kubeflow_tpu.controller.cluster import (
+    Cluster, Pod, Service, create_and_admit,
+)
 
 
 @dataclasses.dataclass
@@ -69,7 +71,7 @@ class NotebookController:
             )
             if self.pod_mutator is not None:
                 pod = self.pod_mutator(pod)
-            self.cluster.create_pod(pod)
+            create_and_admit(self.cluster, pod)   # no gang barrier
         if self.cluster.get_service(namespace, pod_name) is None:
             self.cluster.create_service(Service(
                 name=pod_name, namespace=namespace,
@@ -108,12 +110,13 @@ class TensorBoardController:
         self.boards[(tb.namespace, tb.name)] = tb
         pod_name = f"tensorboard-{tb.name}"
         if self.cluster.get_pod(tb.namespace, pod_name) is None:
-            self.cluster.create_pod(Pod(
+            pod = Pod(
                 name=pod_name, namespace=tb.namespace,
                 labels={"tensorboard": tb.name},
                 env={"TB_LOGDIR": tb.logdir},
                 command=["tensorboard", "--logdir", tb.logdir],
-            ))
+            )
+            create_and_admit(self.cluster, pod)
         if self.cluster.get_service(tb.namespace, pod_name) is None:
             self.cluster.create_service(Service(
                 name=pod_name, namespace=tb.namespace,

@@ -14,7 +14,9 @@ import threading
 import time
 from typing import Optional
 
-from kubeflow_tpu.controller.cluster import Cluster, Pod, PodPhase, Service
+from kubeflow_tpu.controller.cluster import (
+    Cluster, Pod, PodPhase, Service, create_and_admit,
+)
 from kubeflow_tpu.serving.types import (
     InferenceService, ModelFormat, ServingRuntime,
 )
@@ -264,12 +266,16 @@ class ServingController:
                     pod_env = dict(env)
                     if comp == "predictor":
                         pod_env["KFT_BIND"] = self._bind_for_pod()
-                    self.cluster.create_pod(Pod(
+                    pod = Pod(
                         name=pname, namespace=isvc.namespace,
                         labels={"isvc": isvc.name, "component": comp,
                                 "revision": str(revision)},
                         env=pod_env, command=list(runtime.command),
-                        init_command=init))
+                        init_command=init)
+                    # Deployment-style admission: serving pods have no gang
+                    # barrier — start them the moment they exist (the
+                    # production path; tests no longer play kubelet here)
+                    create_and_admit(self.cluster, pod)
 
     def _pods(self, isvc: InferenceService,
               revision: Optional[int] = None) -> list[Pod]:

@@ -77,6 +77,7 @@ class Heartbeat:
             import threading
 
             self._latest: Optional[int] = None
+            self._latest_lock = threading.Lock()
             self._warnings: "queue.Queue[dict]" = queue.Queue()
             self._kick = threading.Event()
             self._stop = threading.Event()
@@ -88,7 +89,8 @@ class Heartbeat:
 
     def beat(self, step: int, warning: Optional[dict] = None) -> None:
         if self.is_url:
-            self._latest = int(step)
+            with self._latest_lock:
+                self._latest = int(step)
             if warning is not None:
                 self._warnings.put(warning)
             self._kick.set()
@@ -98,18 +100,24 @@ class Heartbeat:
             f.write(str(step))
         os.replace(tmp, self.path)
 
-    def _pump(self) -> None:
+    def _take(self) -> tuple[Optional[int], Optional[dict]]:
+        """Atomically claim the pending step (the lock closes the race
+        where a beat lands between the read and the reset) + one warning."""
         import queue
 
+        with self._latest_lock:
+            step, self._latest = self._latest, None
+        try:
+            warning = self._warnings.get_nowait()
+        except queue.Empty:
+            warning = None
+        return step, warning
+
+    def _pump(self) -> None:
         while not self._stop.is_set():
             self._kick.wait()
             self._kick.clear()
-            step, self._latest = self._latest, None
-            warning = None
-            try:
-                warning = self._warnings.get_nowait()
-            except queue.Empty:
-                pass
+            step, warning = self._take()
             if step is not None or warning is not None:
                 post_heartbeat(self.path, step=step, warning=warning)
             if not self._warnings.empty() or self._latest is not None:
@@ -120,6 +128,14 @@ class Heartbeat:
         if self.is_url:
             self._stop.set()
             self._kick.set()
+            self._thread.join(timeout=10.0)
+            # final flush: the last pre-shutdown beat/warnings must not be
+            # lost in the pump — post whatever remains, synchronously
+            while True:
+                step, warning = self._take()
+                if step is None and warning is None:
+                    break
+                post_heartbeat(self.path, step=step, warning=warning)
 
 
 def fit(
