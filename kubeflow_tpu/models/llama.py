@@ -279,7 +279,15 @@ def forward(params, tokens, cfg: LlamaConfig, positions=None, mesh=None,
         cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
         original_max_seq=cfg.max_seq,
     ))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # Embedding lookup, SPMD-clean: a row gather from the (vocab=tensor,
+    # embed=fsdp)-sharded table makes the partitioner emit an "involuntary
+    # full rematerialization" of the [B,S,D] activation (it can't reshard
+    # gather output efficiently). Explicitly replicating the bf16-cast
+    # table first makes the gather local and the batch/seq partition a
+    # free slice — the same table all-gather XLA's fallback pays, minus
+    # the (much larger) activation replication, and warning-free.
+    table = constrain(params["embed"].astype(cfg.dtype), (None, None))
+    x = table[tokens]
     x = constrain(x, ("batch", "seq", "act_embed"))
 
     block = _remat_wrap(
