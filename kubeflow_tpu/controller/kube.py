@@ -377,7 +377,18 @@ class KubeCluster:
         path = self._pod_path(namespace)
         if sel:
             path += f"?labelSelector={quote(sel)}"
-        docs = self._request("GET", path).get("items", [])
+        body = self._request("GET", path)
+        try:
+            # seed the watch cursor from the list (the list+watch resume
+            # semantics): a watch opened after this LIST must start at its
+            # resourceVersion, not replay the server's whole history
+            self._watch_rv = max(
+                getattr(self, "_watch_rv", 0),
+                int((body.get("metadata") or {})
+                    .get("resourceVersion", 0) or 0))
+        except (TypeError, ValueError):
+            pass
+        docs = body.get("items", [])
         out = [self._fold(doc) for doc in docs]
         with self._lock:
             remote = {(p.namespace, p.name) for p in out}
@@ -569,12 +580,28 @@ class KubeCluster:
         if self._informer is not None:
             return
         self._cache_namespace = namespace
-        self._list_pods_rest(namespace, dict(selector))     # prime
-        if not selector:
-            self._cache_serving = True
+        try:
+            self._list_pods_rest(namespace, dict(selector))     # prime
+            if not selector:
+                self._cache_serving = True
+        except Exception:
+            # apiserver transiently down at boot: don't crash startup —
+            # reads stay REST-backed until the loop's first successful
+            # resync primes the cache and flips cache-serving on
+            pass
 
         def loop():
             try:
+                if not self._cache_serving:
+                    while not self._informer_stop.is_set():
+                        try:
+                            self._list_pods_rest(namespace, dict(selector))
+                            if not selector:
+                                self._cache_serving = True
+                            break
+                        except Exception:
+                            if self._informer_stop.wait(1.0):
+                                return
                 last_resync = time.monotonic()
                 while not self._informer_stop.is_set():
                     try:

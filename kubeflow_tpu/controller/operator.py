@@ -508,11 +508,14 @@ class Operator:
     def stop(self):
         self._stop.set()
         if self._pod_event_wake is not None:
+            # only stop the informer THIS operator started (start() sets
+            # _pod_event_wake exactly when it does) — a shared KubeCluster
+            # may have another owner's informer running
             self._pod_event_wake.set()       # unblock the reconcile wait
-        stop_informer = getattr(self.controller.cluster,
-                                "stop_informer", None)
-        if stop_informer is not None:
-            stop_informer()
+            stop_informer = getattr(self.controller.cluster,
+                                    "stop_informer", None)
+            if stop_informer is not None:
+                stop_informer()
         if self._httpd is not None:
             self._httpd.shutdown()
         for t in self._threads:

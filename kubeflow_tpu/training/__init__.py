@@ -1,3 +1,4 @@
 from kubeflow_tpu.training.trainer import Trainer, TrainerConfig, lm_loss_fn, make_optimizer
 from kubeflow_tpu.training.data import batch_sharding, put_batch, synthetic_lm_batches
+from kubeflow_tpu.training.dataset import TokenDataset, write_token_shards
 from kubeflow_tpu.training.metrics import MetricsWriter, objective_from_metrics, read_metrics

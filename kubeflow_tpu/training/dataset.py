@@ -1,0 +1,173 @@
+"""File-backed tokenized corpus: memory-mapped shards, deterministic
+shuffle, exact step-indexed resume.
+
+The data plane is owned by the framework (SURVEY.md §7 design stance — the
+reference delegates data to user containers; here the trainer must be able
+to run the BASELINE ladder on a real on-disk corpus). Storage follows the
+mounted-bucket convention (`serving/storage.py`): a dataset is a directory
+of ``*.tokens.npy`` shards — typically a GCS bucket fuse-mounted into the
+pod — each a 1-D integer array of token ids.
+
+Resume contract: batch ``i`` is a PURE function of ``(corpus, seq_len,
+global_batch, seed, i, process)``. Examples are fixed ``seq_len+1`` windows
+(never crossing shard boundaries); each epoch visits every window once in
+an epoch-seeded permutation; step ``i`` takes the next ``global_batch``
+entries of that infinite stream. ``loop.fit`` checkpoints the trainer step
+and calls ``batches(start_step)`` on restore, so a killed-and-resumed job
+continues the exact step->batch mapping of an uninterrupted one — the
+kill-and-resume e2e in tests/test_dataset.py proves it over a real corpus.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+_SHARD_SUFFIX = ".tokens.npy"
+
+
+def write_token_shards(path: str, tokens, shard_tokens: int = 1 << 22,
+                       vocab_size: Optional[int] = None) -> list[str]:
+    """Materialize a token stream as a shard directory.
+
+    ``tokens``: any iterable of integer arrays/lists (documents or chunks);
+    they are concatenated and split into ``shard_tokens``-sized shards.
+    Streaming: at most one shard's worth of tokens is resident at a time,
+    so a corpus far larger than host memory can be prepared (matching the
+    reader's mmap stance). Returns the shard paths; writes
+    ``dataset.json`` metadata alongside.
+    """
+    os.makedirs(path, exist_ok=True)
+    paths: list[str] = []
+    pending: list[np.ndarray] = []
+    pending_n = total = 0
+
+    def flush(n: int) -> None:
+        nonlocal pending, pending_n
+        if not pending:
+            pending = [np.zeros(0, np.int32)]
+        flat = np.concatenate(pending) if len(pending) != 1 else pending[0]
+        p = os.path.join(path, f"shard-{len(paths):05d}{_SHARD_SUFFIX}")
+        np.save(p, flat[:n])
+        paths.append(p)
+        rest = flat[n:]
+        pending = [rest] if len(rest) else []
+        pending_n = len(rest)
+
+    for t in tokens:
+        chunk = np.asarray(t, dtype=np.int32).ravel()
+        pending.append(chunk)
+        pending_n += len(chunk)
+        total += len(chunk)
+        while pending_n >= shard_tokens:
+            flush(shard_tokens)
+    if pending_n or not paths:
+        flush(pending_n)
+    with open(os.path.join(path, "dataset.json"), "w") as f:
+        json.dump({"total_tokens": total,
+                   "shards": len(paths),
+                   "vocab_size": vocab_size}, f)
+    return paths
+
+
+class TokenDataset:
+    """Memory-mapped reader over a token-shard directory.
+
+    Shards are opened with ``mmap_mode='r'`` — no shard is ever resident in
+    host RAM beyond the pages a batch touches, so a corpus far larger than
+    memory streams at page-cache speed (the mounted-bucket read path).
+    """
+
+    def __init__(self, path: str, seq_len: int, seed: int = 0):
+        names = sorted(n for n in os.listdir(path)
+                       if n.endswith(_SHARD_SUFFIX))
+        if not names:
+            raise FileNotFoundError(
+                f"no {_SHARD_SUFFIX} shards under {path!r}")
+        self.path = path
+        self.seq_len = int(seq_len)
+        self.seed = int(seed)
+        self._shards = [np.load(os.path.join(path, n), mmap_mode="r")
+                        for n in names]
+        # fixed windows of seq_len+1 tokens (inputs + shifted targets),
+        # never crossing a shard boundary: window w of shard s starts at
+        # w*seq_len, so consecutive windows share one boundary token —
+        # every token is trained on exactly once per epoch
+        self._per_shard = [max(0, (len(s) - 1) // self.seq_len)
+                           for s in self._shards]
+        self._cum = np.cumsum([0] + self._per_shard)
+        self.n_windows = int(self._cum[-1])
+        if self.n_windows == 0:
+            raise ValueError(
+                f"corpus too small: no shard holds seq_len+1="
+                f"{self.seq_len + 1} tokens")
+        self._perm_cache: dict[int, np.ndarray] = {}
+
+    # ------------------------------------------------------------ reads --
+
+    def window(self, idx: int) -> np.ndarray:
+        """Window ``idx`` -> int32 [seq_len+1]."""
+        s = int(np.searchsorted(self._cum, idx, side="right") - 1)
+        off = (idx - self._cum[s]) * self.seq_len
+        return np.asarray(
+            self._shards[s][off:off + self.seq_len + 1], dtype=np.int32)
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        """Epoch-seeded shuffle; tiny LRU since training only ever touches
+        the current epoch (plus its neighbor at an epoch boundary)."""
+        p = self._perm_cache.get(epoch)
+        if p is None:
+            p = np.random.default_rng(
+                [self.seed, epoch]).permutation(self.n_windows)
+            self._perm_cache[epoch] = p
+            for k in sorted(self._perm_cache):
+                if len(self._perm_cache) <= 4:
+                    break
+                del self._perm_cache[k]
+        return p
+
+    def window_ids_for_step(self, step: int, global_batch: int) -> np.ndarray:
+        """The global window ids batch ``step`` consumes — the pure
+        step->batch mapping the resume contract is built on."""
+        first = step * global_batch
+        idx = np.arange(first, first + global_batch)
+        epochs = idx // self.n_windows
+        pos = idx % self.n_windows
+        return np.array([self._perm(int(e))[int(p)]
+                         for e, p in zip(epochs, pos)])
+
+    def state(self, step: int, global_batch: int) -> dict:
+        """Observability: where step ``step`` sits in the epoch stream."""
+        consumed = step * global_batch
+        return {"epoch": consumed // self.n_windows,
+                "position": consumed % self.n_windows,
+                "seed": self.seed, "n_windows": self.n_windows}
+
+    # ---------------------------------------------------------- batches --
+
+    def batches(self, global_batch: int,
+                start_step: int = 0) -> Iterator[dict]:
+        """Infinite step-indexed batch stream: {"tokens": [local, S+1]}.
+
+        Multi-host aware like ``synthetic_lm_batches``: each process yields
+        its contiguous slice of the global batch. Pass this (wrapped in a
+        lambda taking start_step) as ``loop.fit``'s ``batches`` callable —
+        the preferred seekable form of the data-resume contract.
+        """
+        import jax
+
+        n_proc = jax.process_count()
+        if global_batch % n_proc:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{n_proc} processes")
+        local = global_batch // n_proc
+        lo = jax.process_index() * local
+        step = start_step
+        while True:
+            ids = self.window_ids_for_step(step, global_batch)[lo:lo + local]
+            yield {"tokens": np.stack([self.window(int(i)) for i in ids])}
+            step += 1

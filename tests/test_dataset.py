@@ -1,0 +1,204 @@
+"""File-backed token dataset: mmap shards, deterministic shuffle, and the
+kill-and-resume contract over a real on-disk corpus (VERDICT r4 Missing #3 /
+round-5 ask #7; SURVEY.md §7 data-plane stance, §5 checkpoint row)."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.training.dataset import TokenDataset, write_token_shards
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _corpus(tmp_path, n_shards=3, shard_len=350, vocab=512, seed=0):
+    rng = np.random.default_rng(seed)
+    docs = [rng.integers(0, vocab, shard_len, dtype=np.int32)
+            for _ in range(n_shards)]
+    d = str(tmp_path / "corpus")
+    write_token_shards(d, docs, shard_tokens=shard_len, vocab_size=vocab)
+    return d, docs
+
+
+def test_writer_reader_round_trip(tmp_path):
+    d, docs = _corpus(tmp_path)
+    ds = TokenDataset(d, seq_len=32)
+    # 3 shards x (350-1)//32 = 10 windows each
+    assert ds.n_windows == 30
+    flat = np.concatenate(docs)
+    # window 0 is the first 33 tokens of the flat stream
+    np.testing.assert_array_equal(ds.window(0), flat[:33])
+    # shards are memory-mapped, not resident copies
+    assert isinstance(ds._shards[0], np.memmap)
+    meta = json.load(open(os.path.join(d, "dataset.json")))
+    assert meta["total_tokens"] == 3 * 350 and meta["shards"] == 3
+
+
+def test_windows_never_cross_shards_and_tile_each_shard(tmp_path):
+    d, docs = _corpus(tmp_path)
+    ds = TokenDataset(d, seq_len=32)
+    per = 10
+    for s in range(3):
+        for w in range(per):
+            got = ds.window(s * per + w)
+            exp = docs[s][w * 32:w * 32 + 33]
+            np.testing.assert_array_equal(got, exp)
+            assert len(got) == 33
+    # consecutive windows of one shard share exactly the boundary token
+    assert ds.window(0)[-1] == ds.window(1)[0]
+
+
+def test_epoch_visits_every_window_once(tmp_path):
+    d, _ = _corpus(tmp_path)
+    ds = TokenDataset(d, seq_len=32, seed=11)
+    ids = np.concatenate([ds.window_ids_for_step(i, 5) for i in range(6)])
+    assert sorted(ids) == list(range(30))           # one full epoch, 6x5
+    # next epoch: same coverage, DIFFERENT order (reshuffled)
+    ids2 = np.concatenate([ds.window_ids_for_step(i, 5)
+                           for i in range(6, 12)])
+    assert sorted(ids2) == list(range(30))
+    assert list(ids) != list(ids2)
+
+
+def test_step_batch_mapping_is_pure(tmp_path):
+    """Two independent readers (a 'resumed process') agree on every step —
+    including steps past an epoch boundary."""
+    d, _ = _corpus(tmp_path)
+    a = TokenDataset(d, seq_len=32, seed=3)
+    b = TokenDataset(d, seq_len=32, seed=3)
+    for step in (0, 5, 7, 13, 29):                  # 30 windows, batch 4
+        np.testing.assert_array_equal(
+            a.window_ids_for_step(step, 4), b.window_ids_for_step(step, 4))
+    ba = next(a.batches(4, start_step=13))
+    bb = next(b.batches(4, start_step=13))
+    np.testing.assert_array_equal(ba["tokens"], bb["tokens"])
+    assert ba["tokens"].shape == (4, 33)
+    # a different seed is a different order
+    c = TokenDataset(d, seq_len=32, seed=4)
+    assert list(c.window_ids_for_step(0, 30)) != \
+        list(a.window_ids_for_step(0, 30))
+
+
+def test_state_reports_epoch_position(tmp_path):
+    d, _ = _corpus(tmp_path)
+    ds = TokenDataset(d, seq_len=32, seed=3)
+    st = ds.state(step=8, global_batch=4)           # 32 consumed, 30/epoch
+    assert st == {"epoch": 1, "position": 2, "seed": 3, "n_windows": 30}
+
+
+def test_corpus_too_small_raises(tmp_path):
+    d = str(tmp_path / "tiny")
+    write_token_shards(d, [np.arange(10)], shard_tokens=10)
+    with pytest.raises(ValueError, match="corpus too small"):
+        TokenDataset(d, seq_len=32)
+
+
+_CHILD = """
+import hashlib, json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.training import (
+    Trainer, TrainerConfig, TokenDataset, lm_loss_fn, put_batch,
+)
+from kubeflow_tpu.training.loop import fit
+
+corpus, ckpt, log_path, kill_at = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+import dataclasses
+cfg = dataclasses.replace(llama.llama_tiny(dtype=jnp.float32),
+                          vocab_size=512)
+ds = TokenDataset(corpus, seq_len=32, seed=5)
+mesh = build_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+trainer = Trainer(
+    mesh=mesh,
+    init_params_fn=lambda rng: llama.init_params(rng, cfg),
+    params_logical_axes=llama.param_logical_axes(cfg),
+    loss_fn=lm_loss_fn(llama.forward, cfg),
+    config=TrainerConfig(learning_rate=1e-3, warmup_steps=2,
+                         total_steps=100))
+
+def batches(start_step):
+    step = start_step
+    for b in ds.batches(4, start_step=start_step):
+        with open(log_path, "a") as f:
+            f.write(json.dumps({
+                "step": step,
+                "sha": hashlib.sha1(b["tokens"].tobytes()).hexdigest(),
+            }) + chr(10))
+        yield put_batch(mesh, b)
+        step += 1
+
+def on_step(step, m):
+    if kill_at and step == kill_at:
+        os._exit(9)        # SIGKILL-equivalent: no cleanup, no final save
+
+r = fit(trainer, batches, rng=jax.random.key(0), max_steps=20,
+        checkpoint_dir=ckpt, checkpoint_every=4, on_step=on_step)
+print("RESUMED_FROM", r.resumed_from, "FINAL", r.final_step, flush=True)
+"""
+
+
+def test_kill_and_resume_continues_exact_mapping(tmp_path):
+    """E2E over a real on-disk corpus: a training process is killed dead at
+    step 12 (os._exit — no graceful save) and a fresh process resumes from
+    the step-12 checkpoint. The resumed run must consume EXACTLY the
+    batches an uninterrupted run would have from step 12 on — the
+    step->batch mapping continues across the kill, epoch boundary
+    included (80 windows consumed over a 30-window corpus)."""
+    d, _ = _corpus(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    script = str(tmp_path / "child.py")
+    with open(script, "w") as f:
+        f.write(_CHILD)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)          # single device is enough
+
+    log1 = str(tmp_path / "run1.jsonl")
+    p1 = subprocess.run(
+        [sys.executable, script, d, ckpt, log1, "12"],
+        env=env, capture_output=True, timeout=540)
+    assert p1.returncode == 9, p1.stderr.decode()[-2000:]   # killed dead
+
+    log2 = str(tmp_path / "run2.jsonl")
+    p2 = subprocess.run(
+        [sys.executable, script, d, ckpt, log2, "0"],
+        env=env, capture_output=True, timeout=540)
+    assert p2.returncode == 0, p2.stderr.decode()[-2000:]
+    m = p2.stdout.split()
+    assert m[0] == b"RESUMED_FROM" and m[3] == b"20", p2.stdout
+    # the kill may land before the async step-12 save finalizes, in which
+    # case resume falls back to the last DURABLE checkpoint (8) and
+    # replays — either way it must be a real mid-run checkpoint
+    resumed_from = int(m[1])
+    assert resumed_from in (8, 12), p2.stdout
+
+    def read(path):
+        return {json.loads(l)["step"]: json.loads(l)["sha"]
+                for l in open(path)}
+
+    run1, run2 = read(log1), read(log2)
+    # the kill really split the work, and the resume started at the
+    # restored step (replaying any steps whose checkpoint was lost)
+    assert max(run1) == 11 and min(run2) == resumed_from
+    # every batch either run consumed — including steps the resumed run
+    # REPLAYED — matches the ground-truth mapping computed straight from
+    # the dataset: the step->batch mapping is one pure function
+    ds = TokenDataset(d, seq_len=32, seed=5)
+    for step, sha in {**run1, **run2}.items():
+        want = hashlib.sha1(
+            next(ds.batches(4, start_step=step))["tokens"].tobytes()
+        ).hexdigest()
+        assert sha == want, f"step {step} diverged after resume"
+    # fit pulls (and logs) one batch past max_steps before breaking, so
+    # step 20 may appear in the log without being trained on
+    assert set(range(20)) <= (set(run1) | set(run2)) <= set(range(21))
