@@ -172,11 +172,79 @@ def _free_port() -> int:
     return port
 
 
+class _ZygoteProc:
+    """Popen-shaped handle for a zygote-forked pod: liveness and exit code
+    arrive over the held-open socket connection (the zygote is the real
+    parent and reaps the child)."""
+
+    def __init__(self, conn, pid: int, pending: bytes = b""):
+        self._conn = conn
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._done = threading.Event()
+        self._pending = pending          # bytes read past the pid message
+        threading.Thread(target=self._reader, daemon=True).start()
+
+    def _reader(self):
+        buf = self._pending
+        try:
+            while b"\n" not in buf:
+                chunk = self._conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+            import json as _json
+
+            self.returncode = int(_json.loads(buf.split(b"\n", 1)[0])["exit"])
+        except Exception:
+            # zygote died (EOF / garbage): its children are reparented to
+            # init and may still be running — kill ours before reporting,
+            # or shutdown() would leave a live orphan it believes dead
+            if self.returncode is None:
+                try:
+                    os.kill(self.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                self.returncode = -1
+        finally:
+            self._done.set()
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+
+    def poll(self) -> Optional[int]:
+        return self.returncode
+
+    def send_signal(self, sig) -> None:
+        if self.returncode is None:
+            try:
+                os.kill(self.pid, sig)
+            except ProcessLookupError:
+                pass
+
+    def kill(self) -> None:
+        self.send_signal(signal.SIGKILL)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if not self._done.wait(timeout):
+            raise subprocess.TimeoutExpired("zygote-pod", timeout)
+        return self.returncode
+
+
 class LocalProcessCluster:
     """Pods are real subprocesses; the e2e path (SURVEY.md §4.3's kind-cluster
-    analogue). `command` runs with the pod env merged over os.environ."""
+    analogue). `command` runs with the pod env merged over os.environ.
 
-    def __init__(self, log_dir: str = "/tmp/kft-pods"):
+    ``warm_pool=True`` starts a pre-imported zygote
+    (rendezvous/zygote.py): pods whose command is the
+    ``[sys.executable, "-m", module, ...]`` form fork from it instead of
+    paying a cold interpreter + jax import — the submit→first-step
+    latency lever (BASELINE.md row 2). Anything else falls back to a
+    plain spawn."""
+
+    def __init__(self, log_dir: str = "/tmp/kft-pods",
+                 warm_pool: bool = False):
         self.pods: dict[tuple[str, str], Pod] = {}
         self.procs: dict[tuple[str, str], subprocess.Popen] = {}
         self.init_procs: dict[tuple[str, str], subprocess.Popen] = {}
@@ -185,7 +253,77 @@ class LocalProcessCluster:
         self.log_dir = log_dir
         self._lock = threading.Lock()   # pods/procs dicts vs async init
         self._starting: set[tuple[str, str]] = set()   # start_pod in flight
+        self.warm_pool = warm_pool
+        self._zygote: Optional[subprocess.Popen] = None
+        self._zygote_sock: Optional[str] = None
+        self._zygote_lock = threading.Lock()
         os.makedirs(log_dir, exist_ok=True)
+        if warm_pool:
+            # eager, non-blocking spawn: the zygote imports while the
+            # daemon boots, so the first pod already finds it ready
+            self._ensure_zygote(wait_s=0)
+
+    # ------------------------------------------------------ warm pool --
+
+    def _ensure_zygote(self, wait_s: float = 3.0) -> Optional[str]:
+        """Start (once) and health-check the zygote; -> socket path or
+        None when not ready within ``wait_s`` (caller falls back to a
+        plain spawn — a pod launch must never block minutes on the
+        optimization; later pods pick the zygote up once it binds).
+        A deliberate pre-warm (bench/daemon startup) passes a long wait."""
+        with self._zygote_lock:
+            if self._zygote is None or self._zygote.poll() is not None:
+                sock = os.path.join(self.log_dir, "zygote.sock")
+                try:
+                    os.unlink(sock)     # a stale socket is not readiness
+                except FileNotFoundError:
+                    pass
+                log = open(os.path.join(self.log_dir, "zygote.log"), "wb")
+                try:
+                    self._zygote = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "kubeflow_tpu.rendezvous.zygote", sock],
+                        stdout=log, stderr=subprocess.STDOUT)
+                except OSError:
+                    return None
+                self._zygote_sock = sock
+            deadline = time.time() + wait_s
+            while time.time() < deadline:
+                if os.path.exists(self._zygote_sock):
+                    return self._zygote_sock
+                if self._zygote.poll() is not None:
+                    return None
+                time.sleep(0.05)
+            return None
+
+    def _zygote_spawn(self, pod: Pod, env: dict,
+                      log_path: str) -> Optional[_ZygoteProc]:
+        import json as _json
+        import socket as _socket
+
+        sock_path = self._ensure_zygote()
+        if sock_path is None:
+            return None
+        try:
+            conn = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            conn.connect(sock_path)
+            conn.sendall(_json.dumps(
+                {"argv": pod.command, "env": env, "log": log_path}
+            ).encode() + b"\n")
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("zygote hung up")
+                buf += chunk
+            # a fast-exiting child can coalesce the pid and exit messages
+            # into one read: frame at the FIRST newline, hand the rest to
+            # the exit reader
+            line, rest = buf.split(b"\n", 1)
+            return _ZygoteProc(conn, int(_json.loads(line)["pid"]),
+                               pending=rest)
+        except (OSError, ValueError, KeyError):
+            return None
 
     def create_pod(self, pod: Pod) -> None:
         key = (pod.namespace, pod.name)
@@ -209,22 +347,32 @@ class LocalProcessCluster:
         env.update(pod.env)
         log = open(os.path.join(self.log_dir, f"{pod.name}.log"), "wb")
 
+        log_path = os.path.join(self.log_dir, f"{pod.name}.log")
+
         def _launch():
             # caller holds self._lock (or no init thread exists yet).
             # A failed spawn (bad command, ENOMEM) marks the pod FAILED —
             # never leaves it wedged Pending with a stuck _starting entry
-            try:
-                proc = subprocess.Popen(
-                    pod.command or [sys.executable, "-c", "pass"],
-                    env=env, stdout=log, stderr=subprocess.STDOUT,
-                )
-            except OSError as e:
-                self._starting.discard(key)
-                pod.phase = PodPhase.FAILED
-                pod.exit_code = -1
-                log.write(f"spawn failed: {e}\n".encode())
-                log.close()
-                return
+            proc = None
+            if self.warm_pool and len(pod.command) >= 3 \
+                    and pod.command[0] == sys.executable \
+                    and pod.command[1] == "-m":
+                proc = self._zygote_spawn(pod, dict(pod.env), log_path)
+                if proc is not None:
+                    log.close()             # the forked child owns its fd
+            if proc is None:
+                try:
+                    proc = subprocess.Popen(
+                        pod.command or [sys.executable, "-c", "pass"],
+                        env=env, stdout=log, stderr=subprocess.STDOUT,
+                    )
+                except OSError as e:
+                    self._starting.discard(key)
+                    pod.phase = PodPhase.FAILED
+                    pod.exit_code = -1
+                    log.write(f"spawn failed: {e}\n".encode())
+                    log.close()
+                    return
             self.procs[key] = proc
             self._starting.discard(key)     # outcome recorded in procs
             pod.phase = PodPhase.RUNNING
@@ -353,3 +501,8 @@ class LocalProcessCluster:
     def shutdown(self):
         for key in list(self.pods):    # pods, not procs: reaps mid-init pods
             self.delete_pod(*key)
+        with self._zygote_lock:
+            if self._zygote is not None and self._zygote.poll() is None:
+                self._zygote.kill()
+                self._zygote.wait(timeout=5)
+            self._zygote = None

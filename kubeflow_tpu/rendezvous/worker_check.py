@@ -13,7 +13,29 @@ import os
 import sys
 
 
+def _phase(phases: dict, name: str) -> None:
+    """Record a named absolute timestamp; flushed to KFT_PHASES_PATH so the
+    operator/bench can decompose submit->first-step into pod spawn /
+    imports / rendezvous / compile+step (BASELINE.md row 2)."""
+    import time
+
+    phases[name] = time.time()
+    path = os.environ.get("KFT_PHASES_PATH")
+    if path:
+        import json
+
+        try:
+            with open(f"{path}.{os.getpid()}", "w") as f:
+                json.dump(phases, f)
+            os.replace(f"{path}.{os.getpid()}",
+                       f"{path}.{os.environ.get('KFT_PROCESS_ID', '0')}")
+        except OSError:
+            pass
+
+
 def main() -> int:
+    phases: dict = {}
+    _phase(phases, "proc_start")
     import jax
 
     if os.environ.get("KFT_FORCE_PLATFORM"):
@@ -24,7 +46,9 @@ def main() -> int:
     from kubeflow_tpu.rendezvous.bootstrap import initialize
     from kubeflow_tpu.training.metrics import MetricsWriter
 
+    _phase(phases, "imports_done")
     world, mesh = initialize()
+    _phase(phases, "rendezvous_done")
     n_local = jax.local_device_count()
     n_global = jax.device_count()
     expected = world.num_processes * n_local
@@ -78,9 +102,15 @@ def main() -> int:
                 cfg.vocab_size, global_batch, 16, start_step=start))
 
         metrics = MetricsWriter(metrics_path) if metrics_path else None
+
+        def _first_step(step, m):
+            if "first_step_done" not in phases:
+                _phase(phases, "first_step_done")
+
         result = fit(trainer, batches, rng=jax.random.key(0),
                      max_steps=steps, metrics=metrics, metrics_every=1,
-                     checkpoint_dir=os.environ.get("KFT_CHECKPOINT_DIR"))
+                     checkpoint_dir=os.environ.get("KFT_CHECKPOINT_DIR"),
+                     on_step=_first_step)
         print(f"worker {world.process_id}: trained to step "
               f"{result.final_step} (resumed_from={result.resumed_from})")
 

@@ -1,0 +1,152 @@
+"""Pre-imported worker zygote: fork()-spawned pods skip cold imports.
+
+Submit→first-step latency (north-star #2, BASELINE.md row 2) is dominated
+on CPU workers by each pod paying a fresh interpreter + ``import jax`` +
+framework imports before rendezvous even starts. The zygote is the
+forkserver answer (the same trick CPython's ``multiprocessing``
+forkserver and Ray's worker pool use): one helper process imports the
+heavy modules ONCE — crucially, importing jax does NOT initialize any
+backend, so the fork inherits warm code with no device state — then forks
+a child per pod in ~milliseconds.
+
+Protocol (one unix-socket connection per pod, held open for its life):
+  daemon -> zygote: one JSON line {"argv": [...], "env": {...}, "log": p}
+  zygote -> daemon: {"pid": N}            after the fork
+  zygote -> daemon: {"exit": code}        when the child exits
+
+The child applies the pod env (backends are uninitialized, so XLA_FLAGS /
+JAX_PLATFORMS / KFT_FORCE_PLATFORM all still take effect), points
+stdout/stderr at the pod log, and runs ``argv`` — which must be the
+``[sys.executable, "-m", module, *args]`` form (anything else is the
+daemon's cue to fall back to a plain spawn).
+
+``LocalProcessCluster(warm_pool=True)`` owns one zygote and routes
+eligible pods through it; everything else is unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+
+
+def _preimport() -> None:
+    """The heavy import set a training worker pays cold."""
+    import jax  # noqa: F401
+    import jax.numpy  # noqa: F401
+    import numpy  # noqa: F401
+    import optax  # noqa: F401
+
+    from kubeflow_tpu import models, training  # noqa: F401
+    from kubeflow_tpu.rendezvous import bootstrap  # noqa: F401
+
+    # invariant the whole design rests on: imports must not have touched a
+    # backend (a forked live TPU/CPU client would be corrupt)
+    from jax._src import xla_bridge
+
+    assert not xla_bridge._backends, "zygote initialized a JAX backend"
+
+
+def _run_child(req: dict) -> None:
+    """In the forked child: become the pod process."""
+    os.setsid()                              # own signal group, like Popen
+    try:
+        # die with the zygote: a killed zygote must not leave orphaned
+        # workers holding devices (PR_SET_PDEATHSIG=1; the handler thread
+        # that forked us lives in waitpid until we exit, so the Linux
+        # thread-death caveat cannot fire early)
+        import ctypes
+
+        ctypes.CDLL(None, use_errno=True).prctl(1, 9, 0, 0, 0)
+    except Exception:
+        pass
+    argv = req["argv"]
+    env = req.get("env") or {}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    fd = os.open(req["log"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    if os.environ.get("KFT_FORCE_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms",
+                          os.environ["KFT_FORCE_PLATFORM"])
+    # [python, -m, module, *args] — validated by the daemon before routing
+    module = argv[2]
+    sys.argv = [argv[0]] + argv[3:]
+    import runpy
+
+    runpy.run_module(module, run_name="__main__", alter_sys=True)
+
+
+def serve(sock_path: str) -> int:
+    _preimport()
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    srv.bind(sock_path)
+    srv.listen(64)
+    print("zygote ready", flush=True)
+    import threading
+
+    def handle(conn: socket.socket) -> None:
+        try:
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            req = json.loads(buf)
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    srv.close()
+                    conn.close()
+                    _run_child(req)
+                    os._exit(0)
+                except SystemExit as e:
+                    # CPython semantics: int -> that code; None -> 0;
+                    # anything else (sys.exit("message")) -> stderr + 1
+                    if e.code is None:
+                        os._exit(0)
+                    if isinstance(e.code, int):
+                        os._exit(e.code)
+                    print(e.code, file=sys.stderr)
+                    os._exit(1)
+                except BaseException:
+                    import traceback
+
+                    traceback.print_exc()
+                    os._exit(1)
+            conn.sendall(json.dumps({"pid": pid}).encode() + b"\n")
+            _, status = os.waitpid(pid, 0)
+            code = os.waitstatus_to_exitcode(status)
+            try:
+                conn.sendall(json.dumps({"exit": code}).encode() + b"\n")
+            except OSError:
+                pass                        # daemon gone; child is reaped
+        finally:
+            conn.close()
+
+    while True:
+        conn, _ = srv.accept()
+        threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 1:
+        print("usage: python -m kubeflow_tpu.rendezvous.zygote <socket>",
+              file=sys.stderr)
+        return 2
+    return serve(args[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,3 +80,66 @@ def test_jaxjob_failure_restarts_then_fails(client, tmp_path):
     done = client.wait_for_job_conditions("e2e-fail", timeout=60)
     assert done.status.condition() == ConditionType.FAILED
     assert done.status.restart_count == 1
+
+
+def test_jaxjob_world_via_warm_pool(tmp_path):
+    """warm_pool=True: workers fork from the pre-imported zygote instead
+    of paying a cold interpreter + jax import (the submit->first-step
+    lever, BASELINE.md row 2) — the same 2-process world must rendezvous
+    and run its collective, and the phases file must show the fork-warm
+    import path."""
+    import json
+
+    cluster = LocalProcessCluster(log_dir=str(tmp_path / "pods"),
+                                  warm_pool=True)
+    ctl = JobController(cluster)
+    client = TrainingClient(ctl)
+    try:
+        env = base_env(tmp_path)
+        env["KFT_PHASES_PATH"] = str(tmp_path / "phases")
+        client.create_jax_job(
+            "e2e-warm", workers=2, command=WORKER_CMD,
+            mesh={"data": 2}, env=env,
+        )
+        done = client.wait_for_job_conditions("e2e-warm", timeout=180)
+        logs = client.get_job_logs("e2e-warm", index=0)
+        assert done.status.condition() == ConditionType.SUCCEEDED, logs
+        assert "world ok" in logs
+        phases = json.load(open(str(tmp_path / "phases") + ".0"))
+        # forked from the zygote: jax was already imported, so the
+        # import phase is near-zero (vs seconds on a cold interpreter)
+        assert phases["imports_done"] - phases["proc_start"] < 2.0
+        assert phases["rendezvous_done"] >= phases["imports_done"]
+    finally:
+        cluster.shutdown()
+
+
+def test_warm_pool_failed_pod_reports_failed(tmp_path):
+    """A zygote-forked pod that dies (bad module / sys.exit) must surface
+    as FAILED with its exit code — fast-exit children coalesce the
+    pid+exit socket messages, which once wedged the pod Pending."""
+    import time
+
+    from kubeflow_tpu.controller.cluster import (
+        Pod, PodPhase, admit_pod,
+    )
+
+    cluster = LocalProcessCluster(log_dir=str(tmp_path / "pods"),
+                                  warm_pool=True)
+    try:
+        assert cluster._ensure_zygote(wait_s=120) is not None
+        pod = Pod(name="doomed", namespace="default", labels={}, env={},
+                  command=[sys.executable, "-m",
+                           "kubeflow_tpu.no_such_module"])
+        cluster.create_pod(pod)
+        admit_pod(cluster, pod)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            p = cluster.get_pod("default", "doomed")
+            if p.phase == PodPhase.FAILED:
+                break
+            time.sleep(0.1)
+        assert p.phase == PodPhase.FAILED and p.exit_code == 1
+        assert "no_such_module" in cluster.pod_log("default", "doomed")
+    finally:
+        cluster.shutdown()
