@@ -98,6 +98,15 @@ def admit_pod(cluster: Cluster, pod: Pod) -> None:
         start(pod)
 
 
+def allocate_bind(cluster: Cluster) -> Optional[str]:
+    """Per-pod bind address on image-less backends: clusters with an
+    ``allocate_port`` hook (local processes sharing one host) get a
+    distinct ``127.0.0.1:port`` per pod — the pod-IP analogue. Returns
+    None on real-cluster backends (pods bind their container port)."""
+    alloc = getattr(cluster, "allocate_port", None)
+    return f"127.0.0.1:{alloc()}" if alloc is not None else None
+
+
 def create_and_admit(cluster: Cluster, pod: Pod) -> None:
     """Deployment-style pod creation: create + immediately admit. A lost
     create race (another reconcile pass — or, on kube, a lagging informer

@@ -1,0 +1,53 @@
+"""Minimal notebook server for image-less backends.
+
+On a real cluster a Notebook pod's container image (jupyter) provides the
+entrypoint, so the controller leaves ``command`` empty and kubelet runs
+the image. `LocalProcessCluster` has no images — an empty command would
+exit immediately and the notebook would never be Running. This stub is
+the local stand-in entrypoint: a live HTTP server on ``KFT_BIND`` with a
+jupyter-shaped liveness surface (``/api`` -> version JSON, ``/`` -> a
+placeholder page), enough for the controller's Running state, the
+service's endpoint resolution, and the culling clock to be real.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main() -> int:
+    bind = os.environ.get("KFT_BIND", "127.0.0.1:8888")
+    host, _, port = bind.rpartition(":")
+    name = os.environ.get("KFT_NOTEBOOK_NAME", "notebook")
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/api"):
+                body = json.dumps({"version": "kft-notebook-stub",
+                                   "name": name}).encode()
+                ctype = "application/json"
+            else:
+                body = (f"<html><body><h1>{name}</h1>"
+                        "<p>kubeflow-tpu notebook (local backend stub — "
+                        "a real deployment runs the notebook image "
+                        "entrypoint here)</p></body></html>").encode()
+                ctype = "text/html"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+    print(f"notebook stub serving on {bind}", flush=True)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

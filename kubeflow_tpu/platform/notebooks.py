@@ -64,10 +64,27 @@ class NotebookController:
             self.cluster.delete_pod(namespace, pod_name)
             return nb
         if self.cluster.get_pod(namespace, pod_name) is None:
+            env = dict(nb.env)
+            command: list = []
+            from kubeflow_tpu.controller.cluster import allocate_bind
+
+            if getattr(self.cluster, "allocate_port", None) is not None:
+                # image-less backend (local processes): an empty command
+                # would exit immediately — run the stub notebook server on
+                # a per-pod port so the pod is genuinely Running and the
+                # service resolves to a live endpoint. Real clusters keep
+                # command=[] and run the notebook image's entrypoint.
+                import sys
+
+                if "KFT_BIND" not in env:
+                    env["KFT_BIND"] = allocate_bind(self.cluster)
+                env.setdefault("KFT_NOTEBOOK_NAME", name)
+                command = [sys.executable, "-m",
+                           "kubeflow_tpu.platform.notebook_stub"]
             pod = Pod(
                 name=pod_name, namespace=namespace,
                 labels={"notebook": name, "app": "notebook"},
-                env=dict(nb.env), command=[],
+                env=env, command=command,
             )
             if self.pod_mutator is not None:
                 pod = self.pod_mutator(pod)

@@ -227,11 +227,11 @@ class ServingController:
                                     isvc.namespace)
 
     def _bind_for_pod(self) -> str:
-        """Per-pod bind address. Clusters with an allocate_port hook (local
-        processes sharing one host) get a distinct port per pod — the pod-IP
-        analogue; real-cluster renderers bind the container port."""
-        alloc = getattr(self.cluster, "allocate_port", None)
-        return f"127.0.0.1:{alloc()}" if alloc else "0.0.0.0:8080"
+        """Per-pod bind address (see cluster.allocate_bind); real-cluster
+        renderers bind the container port."""
+        from kubeflow_tpu.controller.cluster import allocate_bind
+
+        return allocate_bind(self.cluster) or "0.0.0.0:8080"
 
     def _create_revision_pods(self, isvc: InferenceService,
                               runtime: ServingRuntime, revision: int) -> None:

@@ -550,3 +550,53 @@ def test_tpu_pod_template_contract():
     assert sel["cloud.google.com/gke-tpu-topology"] == "4x4x4"
     limits = tmpl["containers"][0]["resources"]["limits"]
     assert "google.com/tpu" in limits and "nvidia.com/gpu" not in limits
+
+
+def test_notebook_runs_live_server_on_local_backend(tmp_path):
+    """On the image-less local backend a Notebook pod must be a real
+    Running process serving HTTP (the stub entrypoint) — not an instant
+    exit — and culling must stop it through the production path."""
+    import os
+    import time
+    import urllib.request
+
+    import kubeflow_tpu
+    from kubeflow_tpu.controller.cluster import (
+        LocalProcessCluster, PodPhase,
+    )
+    from kubeflow_tpu.platform.notebooks import Notebook, NotebookController
+
+    repo = os.path.dirname(os.path.dirname(kubeflow_tpu.__file__))
+    cluster = LocalProcessCluster(log_dir=str(tmp_path / "pods"))
+    try:
+        ctl = NotebookController(cluster)
+        ctl.apply(Notebook(name="nb1", env={
+            "PYTHONPATH": repo + ":" + os.environ.get("PYTHONPATH", "")}))
+        pod = cluster.get_pod("default", "notebook-nb1")
+        assert pod is not None and pod.phase == PodPhase.RUNNING
+        bind = pod.env["KFT_BIND"]
+        deadline = time.time() + 60
+        body = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{bind}/api", timeout=2) as r:
+                    body = r.read()
+                break
+            except Exception:
+                if cluster.get_pod("default", "notebook-nb1").phase \
+                        != PodPhase.RUNNING:
+                    raise AssertionError(
+                        cluster.pod_log("default", "notebook-nb1"))
+                time.sleep(0.2)
+        assert body and b"nb1" in body
+        # culling kills the process; touch() restarts it
+        nb = ctl.notebooks[("default", "nb1")]
+        nb.last_activity = time.time() - 10_000
+        assert ctl.cull_idle() == ["default/nb1"]
+        assert cluster.get_pod("default", "notebook-nb1") is None
+        ctl.touch("default", "nb1")
+        assert cluster.get_pod("default", "notebook-nb1").phase \
+            == PodPhase.RUNNING
+    finally:
+        cluster.shutdown()
