@@ -255,6 +255,18 @@ class KubeCluster:
                                 manifest)
         except KubeApiError as e:
             if e.code == 409:
+                # lost a create race. In cache-serving mode the winner may
+                # not have inserted its cache entry yet (its POST returned
+                # but the lock section hasn't run) — fold the server copy
+                # so this thread's very next read already sees the pod
+                if self._cache_covers(pod.namespace):
+                    try:
+                        self._fold(self._request(
+                            "GET", self._pod_path(pod.namespace, pod.name)))
+                    except (KubeApiError, OSError,
+                            http.client.HTTPException):
+                        pass    # best-effort only: the winner's insert or
+                        #         the next watch event repairs the cache
                 raise KeyError(f"pod {key} exists") from e
             raise
         with self._lock:

@@ -488,7 +488,16 @@ def test_http_heartbeat_contract_over_kube_backend(apiserver, tmp_path):
         job = jax_job("hb-kube", workers=1, mesh={"data": 1})
         op.submit(job)
         ctl.reconcile("default", "hb-kube")
-        pod = kube.list_pods("default", {"job-name": "hb-kube"})[0]
+        # this reconcile races the daemon's event-driven one; whoever wins
+        # the create, the pod appears in the shared cache — poll the
+        # eventually-consistent read rather than indexing immediately
+        deadline = time.time() + 15
+        pods = []
+        while time.time() < deadline and not pods:
+            pods = kube.list_pods("default", {"job-name": "hb-kube"})
+            time.sleep(0.05)
+        assert pods, "pod hb-kube never appeared in the informer cache"
+        pod = pods[0]
         url = pod.env["KFT_HEARTBEAT_FILE"]
         assert url.startswith("http://"), url
         assert pod.env["KFT_WARNING_FILE"] == url
