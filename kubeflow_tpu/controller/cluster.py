@@ -266,6 +266,10 @@ class LocalProcessCluster:
         self._zygote: Optional[subprocess.Popen] = None
         self._zygote_sock: Optional[str] = None
         self._zygote_lock = threading.Lock()
+        # observability: pods that wanted the warm pool but cold-spawned —
+        # an entrypoint rename silently regressing submit latency is
+        # exactly the kind of thing this counter surfaces (bench reads it)
+        self.zygote_fallbacks = 0
         os.makedirs(log_dir, exist_ok=True)
         if warm_pool:
             # eager, non-blocking spawn: the zygote imports while the
@@ -363,12 +367,27 @@ class LocalProcessCluster:
             # A failed spawn (bad command, ENOMEM) marks the pod FAILED —
             # never leaves it wedged Pending with a stuck _starting entry
             proc = None
-            if self.warm_pool and len(pod.command) >= 3 \
-                    and pod.command[0] == sys.executable \
-                    and pod.command[1] == "-m":
-                proc = self._zygote_spawn(pod, dict(pod.env), log_path)
+            if self.warm_pool:
+                eligible = (len(pod.command) >= 3
+                            and pod.command[0] == sys.executable
+                            and pod.command[1] == "-m")
+                if eligible:
+                    proc = self._zygote_spawn(pod, dict(pod.env), log_path)
                 if proc is not None:
                     log.close()             # the forked child owns its fd
+                else:
+                    # cold spawn despite warm_pool: say so, loudly enough
+                    # to find (pod log + counter), quietly enough to run
+                    self.zygote_fallbacks += 1
+                    reason = (
+                        "command is not [sys.executable, -m, module]"
+                        if not eligible
+                        else "zygote spawn failed (not ready, or RPC error"
+                             " — see zygote log)")
+                    log.write(
+                        f"warm-pool fallback: {reason}; cold spawn of "
+                        f"{pod.command!r}\n".encode())
+                    log.flush()
             if proc is None:
                 try:
                     proc = subprocess.Popen(

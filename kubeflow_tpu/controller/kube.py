@@ -275,7 +275,25 @@ class KubeCluster:
                     (doc.get("metadata") or {}).get("resourceVersion", 0))
             except (TypeError, ValueError):
                 pod._rv = 0
-            self._pods[key] = pod
+            existing = self._pods.get(key)
+            if (existing is not None and existing is not pod and pod._rv
+                    and getattr(existing, "_rv", 0) >= pod._rv):
+                # rv >= this POST's creation rv: the informer folded THIS
+                # incarnation's watch events before this section ran. That
+                # object carries remote state at least as new as the POST
+                # (phase/node, possibly already terminal) and concurrent
+                # readers hold it — merge the creator's env into it instead
+                # of clobbering the entry.
+                for k, v in pod.env.items():
+                    existing.env.setdefault(k, v)
+            else:
+                # no entry, or one whose rv predates this POST — a stale
+                # prior incarnation of the name (the server must have
+                # deleted it for our POST to succeed). Replace it: merging
+                # could wedge the new pod terminal forever (_apply_remote
+                # never resurrects), and the fresh rv fences out the old
+                # incarnation's lagging watch events.
+                self._pods[key] = pod
             if pod.gang:
                 self._gated.add(key)
             self._pushed_env[key] = dict(pod.env)
@@ -319,6 +337,14 @@ class KubeCluster:
         try:
             rv = int((doc.get("metadata") or {})
                      .get("resourceVersion", 0) or 0)
+            if rv and rv < getattr(pod, "_rv", 0):
+                # incarnation fence (the non-DELETED half; watch_pods
+                # fences DELETED): a lagging event carrying an OLDER rv —
+                # a prior same-name incarnation's MODIFIED, or a replay
+                # after watch restart — must not rewrite state the cache
+                # learned from a newer rv (e.g. wedge a freshly
+                # re-created pod terminal)
+                return
             pod._rv = max(getattr(pod, "_rv", 0), rv)
         except (TypeError, ValueError):
             pass
@@ -375,9 +401,12 @@ class KubeCluster:
     def list_pods(self, namespace: str,
                   selector: dict[str, str]) -> list[Pod]:
         if self._cache_covers(namespace):
+            # ns "" = cluster-wide, mirroring the REST path (/api/v1/pods):
+            # a cluster-scope informer must serve cluster-scope lists from
+            # its cache, not an always-empty namespace match
             with self._lock:
                 return [p for (ns, _), p in self._pods.items()
-                        if ns == namespace
+                        if (not namespace or ns == namespace)
                         and all(p.labels.get(k) == v
                                 for k, v in selector.items())]
         return self._list_pods_rest(namespace, selector)

@@ -30,6 +30,16 @@ import json
 import os
 import socket
 import sys
+import threading
+
+# fork() while sibling handler threads are mid-malloc/mid-lock is the
+# classic threaded-fork deadlock: the child inherits a heap/lock snapshot
+# whose owners don't exist there. Serializing forks doesn't remove that
+# hazard entirely (accept loops and CPython runtime threads still exist),
+# but it guarantees no two handler threads interleave fork bookkeeping,
+# which is where the observed wedges live. Held only around os.fork()
+# itself — waitpid runs unlocked so forks never serialize on pod LIFETIME.
+_fork_lock = threading.Lock()
 
 
 def _preimport() -> None:
@@ -92,7 +102,6 @@ def serve(sock_path: str) -> int:
     srv.bind(sock_path)
     srv.listen(64)
     print("zygote ready", flush=True)
-    import threading
 
     def handle(conn: socket.socket) -> None:
         try:
@@ -103,7 +112,8 @@ def serve(sock_path: str) -> int:
                     return
                 buf += chunk
             req = json.loads(buf)
-            pid = os.fork()
+            with _fork_lock:
+                pid = os.fork()
             if pid == 0:
                 try:
                     srv.close()

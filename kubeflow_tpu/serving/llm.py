@@ -127,15 +127,30 @@ class LLMEngine:
                  kv_num_blocks: Optional[int] = None,
                  decode_chunk: int = 8,
                  decode_pipeline: bool = True,
+                 kernel: str = "auto",
                  mesh=None):
         from kubeflow_tpu.serving.paged_kv import (
-            PagedKV, _lm_head as lm_head_fn,
+            PagedKV, _lm_head as lm_head_fn, _resolve_decode_kernel,
             paged_prefill_chunk as paged_prefill_chunk_fn,
         )
 
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
+        # decode-attention path (paged_kv module docstring): the
+        # block-resident Pallas kernel is the TPU default; the gather view
+        # stays as the reference oracle AND the only path XLA can
+        # auto-partition, so any multi-chip mesh pins it. Resolution is
+        # delegated to paged_kv so self.kernel always names the path the
+        # decode step actually executes (e.g. gpu downgrades pallas).
+        resolved = _resolve_decode_kernel(kernel)
+        if mesh is not None:
+            if kernel == "pallas":
+                raise ValueError(
+                    "kernel='pallas' cannot be auto-partitioned over a "
+                    "mesh; use kernel='gather' (or shard_map the engine)")
+            resolved = "gather"
+        self.kernel = resolved
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.buckets = sorted(b for b in prefill_buckets if b <= max_seq)
@@ -236,7 +251,7 @@ class LLMEngine:
                 jnp.take_along_axis(logits, tok[:, None], axis=-1)[:, 0]
                 - jax.nn.logsumexp(logits, axis=-1)))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,),
-                               static_argnames=("greedy_only",))
+                               static_argnames=("greedy_only", "kernel"))
         self._merge_tok = jax.jit(
             lambda carry, upd, mask: jnp.where(mask, upd, carry))
         self._insert_batch = jax.jit(self._insert_batch_impl,
@@ -249,13 +264,13 @@ class LLMEngine:
     # ---------------- jitted bodies ----------------
 
     def _decode_impl(self, params, token, cache, tables, active, temperature,
-                     top_k, top_p, rng, greedy_only=False):
+                     top_k, top_p, rng, greedy_only=False, kernel="gather"):
         from kubeflow_tpu.serving.paged_kv import paged_decode_step
 
         def one_step(carry, rng_step):
             token, cache = carry
             logits, cache = paged_decode_step(
-                params, token, self.cfg, cache, tables)
+                params, token, self.cfg, cache, tables, kernel=kernel)
             nxt = sample_logits(logits, rng_step, temperature, top_k,
                                 top_p, greedy_only=greedy_only)
             # chosen-token logprob under the MODEL distribution (OpenAI
@@ -381,7 +396,8 @@ class LLMEngine:
                 jnp.asarray(top_k), jnp.asarray(top_p), step_rng,
                 # static: an all-greedy batch skips the per-step
                 # full-vocab sort (two compile variants total)
-                greedy_only=not bool((temp > 0).any()))
+                greedy_only=not bool((temp > 0).any()),
+                kernel=self.kernel)
             new_inflight = {
                 "toks": toks, "lps": lps, "next": next_tok,
                 # snapshot: tokens belong to the requests active at

@@ -13,10 +13,23 @@ Everything stays static-shape for XLA: the pool and the [max_batch,
 max_blocks_per_seq] table array never change shape; tables are
 host-managed numpy (the scheduler allocates blocks at admission — enough
 for prompt + max_tokens, so decode can never run out mid-flight) and ride
-into the jitted step as a plain traced argument. The decode step gathers
-each slot's blocks into its logical [max_seq] view; XLA fuses the gather
-into the attention reads. (A Pallas block-resident paged-attention kernel
-can replace the gather later without changing this interface.)
+into the jitted step as a plain traced argument.
+
+Decode attention has two execution paths, selected by
+``paged_decode_step(..., kernel=)``:
+
+- ``"gather"`` — materialize each slot's logical [max_seq] view
+  (``k_pool[tables]``) and run dense GQA attention over it. Per-step HBM
+  traffic scales with the ARENA (r5 ablation: view cost follows max_seq,
+  not live length). Retained as the reference oracle and the only path
+  that XLA can auto-partition (TP-sharded pools).
+- ``"pallas"`` — the first-party block-resident kernel
+  (``ops/pallas_paged_attention.py``): per slot, stream only the live
+  blocks named by its table row through VMEM and run grouped-query
+  attention with an online-softmax accumulator in-kernel. HBM traffic is
+  O(live tokens); no view is ever materialized. On CPU the SAME kernel
+  logic runs under the Pallas interpreter (``interpret=True``), so tier-1
+  tests exercise the exact code path that compiles for TPU.
 """
 
 from __future__ import annotations
@@ -299,9 +312,29 @@ def paged_insert_batch(cache, k_new, v_new, blk_ids, lengths, slots):
     return {"k": k, "v": v, "len": ln}
 
 
-def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
+def _resolve_decode_kernel(kernel: str) -> str:
+    """Map the ``kernel=`` switch to an executable path on this backend.
+    "auto": pallas on TPU, gather elsewhere. An explicit "pallas" request
+    holds on TPU and CPU (interpret mode); other platforms (gpu) fall
+    back to gather, mirroring ops/attention.py's impl dispatch."""
+    if kernel not in ("auto", "pallas", "gather"):
+        raise ValueError(f"kernel={kernel!r} (want auto|pallas|gather)")
+    platform = jax.default_backend()
+    if kernel == "auto":
+        return "pallas" if platform == "tpu" else "gather"
+    if kernel == "pallas" and platform not in ("tpu", "cpu"):
+        return "gather"
+    return kernel
+
+
+def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
+                      kernel: str = "gather"):
     """One decode step over the paged pool. token: [B] int32; tables:
-    [B, max_blocks_per_seq] int32 -> (logits [B, V], cache)."""
+    [B, max_blocks_per_seq] int32 -> (logits [B, V], cache). ``kernel``
+    picks the attention path (module docstring): "gather" | "pallas" |
+    "auto"."""
+    kernel = _resolve_decode_kernel(kernel)
+    interpret = jax.default_backend() == "cpu"
     b = token.shape[0]
     bs = cache["k"].shape[2]
     pos = cache["len"]                                   # [B]
@@ -322,11 +355,23 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables):
         # scatter this step's KV row into each slot's current block
         k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
-        # gather each slot's logical view: block j of slot b holds logical
-        # positions [j*bs, (j+1)*bs) — table order IS sequence order
-        k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
-        v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
-        o = decode_attention(q, k_view, v_view, pos + 1)
+        if kernel == "pallas":
+            # block-resident kernel: per slot, only the live blocks named
+            # by its table row move HBM->VMEM; no [max_seq] view exists
+            from kubeflow_tpu.ops.pallas_paged_attention import (
+                paged_decode_attention,
+            )
+
+            o = paged_decode_attention(
+                q[:, 0], k_pool, v_pool, tables, pos + 1,
+                interpret=interpret)[:, None]
+        else:
+            # gather each slot's logical view: block j of slot b holds
+            # logical positions [j*bs, (j+1)*bs) — table order IS
+            # sequence order
+            k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
+            v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
+            o = decode_attention(q, k_view, v_view, pos + 1)
         # idle slots hold len 0: keep their garbage rows out of MoE routing
         return _layer_out(lp, x, o, cfg,
                           token_mask=(pos > 0)[:, None]), (k_pool, v_pool)

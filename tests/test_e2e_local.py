@@ -143,3 +143,37 @@ def test_warm_pool_failed_pod_reports_failed(tmp_path):
         assert "no_such_module" in cluster.pod_log("default", "doomed")
     finally:
         cluster.shutdown()
+
+
+def test_warm_pool_ineligible_command_falls_back_visibly(tmp_path):
+    """A warm_pool cluster handed a command that is NOT
+    [sys.executable, -m, module] (e.g. a renamed entrypoint) must still
+    run the pod — cold spawn — but say so: the cluster counter ticks and
+    the pod log names the reason, so a rename silently regressing submit
+    latency back to cold-start shows up in bench output instead of
+    nowhere."""
+    import time
+
+    from kubeflow_tpu.controller.cluster import Pod, PodPhase, admit_pod
+
+    cluster = LocalProcessCluster(log_dir=str(tmp_path / "pods"),
+                                  warm_pool=True)
+    try:
+        assert cluster.zygote_fallbacks == 0
+        pod = Pod(name="renamed", namespace="default", labels={}, env={},
+                  command=[sys.executable, "-c", "print('cold ok')"])
+        cluster.create_pod(pod)
+        admit_pod(cluster, pod)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            p = cluster.get_pod("default", "renamed")
+            if p.phase == PodPhase.SUCCEEDED:
+                break
+            time.sleep(0.05)
+        assert p.phase == PodPhase.SUCCEEDED
+        assert cluster.zygote_fallbacks == 1
+        log = cluster.pod_log("default", "renamed")
+        assert "warm-pool fallback" in log
+        assert "cold ok" in log
+    finally:
+        cluster.shutdown()

@@ -229,6 +229,74 @@ def test_informer_keeps_cache_fresh_without_reads(kube):
         kube.stop_informer()
 
 
+def test_cluster_scope_list_cache_matches_rest(kube):
+    """ns "" = cluster-wide on BOTH list paths. The cache-serving branch
+    once matched ``ns == ""`` literally and returned [] for every
+    cluster-scope list the REST path answered — the two paths must agree,
+    and a namespaced list from the same cluster-scope cache must still
+    filter."""
+    for ns in ("default", "other"):
+        kube.create_pod(Pod(name=f"cs-{ns}", namespace=ns,
+                            labels={"app": "cs"}, env={}, command=[]))
+    rest = {(p.namespace, p.name) for p in kube.list_pods("", {"app": "cs"})}
+    assert rest == {("default", "cs-default"), ("other", "cs-other")}
+    kube.start_informer("")              # cluster-scope cache-serving
+    try:
+        cached = {(p.namespace, p.name)
+                  for p in kube.list_pods("", {"app": "cs"})}
+        assert cached == rest
+        assert {(p.namespace, p.name)
+                for p in kube.list_pods("other", {"app": "cs"})} == {
+                    ("other", "cs-other")}
+    finally:
+        kube.stop_informer()
+
+
+def test_create_pod_merges_into_informer_folded_entry(kube):
+    """If the informer folds the POST's watch event before create_pod's
+    cache-insert section runs, the cache already holds an object that
+    concurrent readers may reference — create_pod must merge into it
+    (preserving identity and any newer remote state), not clobber it."""
+    kube.start_informer("default")
+    try:
+        folded = Pod(name="race", namespace="default", labels={}, env={},
+                     command=[])
+        folded.node = "node-7"               # newer remote state
+        folded._rv = 10 ** 9
+        with kube._lock:
+            kube._pods[("default", "race")] = folded
+        kube.create_pod(Pod(name="race", namespace="default", labels={},
+                            env={"K": "v"}, command=[]))
+        got = kube.get_pod("default", "race")
+        assert got is folded                 # identity preserved
+        assert got.node == "node-7"          # newer state not clobbered
+        assert got._rv == 10 ** 9            # rv merged as max, not reset
+        assert got.env["K"] == "v"           # creator's env merged in
+    finally:
+        kube.stop_informer()
+
+
+def test_apply_remote_fences_older_rv_events(kube):
+    """The non-DELETED half of the incarnation fence: a lagging MODIFIED
+    carrying an older rv (a prior same-name incarnation, or a replay after
+    watch restart) must not rewrite state learned from a newer rv — e.g.
+    wedge a freshly re-created pod terminal."""
+    pod = Pod(name="fence", namespace="default", labels={}, env={},
+              command=[])
+    pod._rv = 100
+    stale = {"metadata": {"name": "fence", "namespace": "default",
+                          "resourceVersion": "6"},
+             "status": {"phase": "Failed"},
+             "spec": {}}
+    kube._apply_remote(pod, stale)
+    assert pod.phase == PodPhase.PENDING and pod._rv == 100
+    fresh = dict(stale, metadata={"name": "fence", "namespace": "default",
+                                  "resourceVersion": "101"},
+                 status={"phase": "Running"})
+    kube._apply_remote(pod, fresh)
+    assert pod.phase == PodPhase.RUNNING and pod._rv == 101
+
+
 # ----------------------------------------------- adoption after restart --
 
 def test_fresh_client_adopts_existing_pods(apiserver, kube):

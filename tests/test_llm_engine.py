@@ -650,6 +650,61 @@ def test_pipelined_decode_matches_synchronous(tiny):
     assert outs[True] == outs[False]
 
 
+def test_engine_kernel_pallas_end_to_end(tiny):
+    """The block-resident Pallas decode kernel (the TPU default), selected
+    explicitly on CPU (interpret mode): the engine must run end-to-end
+    through churn/retirement with sampling behavior and slot bookkeeping
+    identical to the gather oracle."""
+    cfg, params = tiny
+    outs = {}
+    for kern in ("gather", "pallas"):
+        eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                        prefill_buckets=(8,), decode_chunk=3, kernel=kern)
+        assert eng.kernel == kern
+        # more requests than slots + uneven budgets: retirement mid-chunk,
+        # slot reuse, and a mid-flight join all run on the kernel path
+        reqs = [eng.add_request([3 + i, 4 + i],
+                                SamplingParams(max_tokens=5 + (i % 2)))
+                for i in range(3)]
+        for _ in range(2):
+            eng.step()
+        late = eng.add_request([9, 10, 11], SamplingParams(max_tokens=4))
+        while eng.has_work():
+            eng.step()
+        assert all(r.done for r in reqs + [late])
+        assert sorted(eng._free) == [0, 1]         # every slot came back
+        for r in reqs + [late]:
+            assert len(r.generated) == r.sampling.max_tokens
+            assert r.finish_reason == "length"
+            assert_greedy_consistent(params, cfg, r.prompt, r.generated)
+        outs[kern] = [r.generated for r in reqs + [late]]
+    # both paths see identical batch compositions step-for-step; the
+    # kernel must not change a single sampled token
+    assert outs["pallas"] == outs["gather"]
+
+
+def test_engine_kernel_auto_and_mesh_resolution(tiny):
+    """kernel="auto" resolves to gather off-TPU; a mesh pins gather (the
+    Mosaic kernel cannot be auto-partitioned) and an explicit "pallas"
+    with a mesh is an error, not a silent fallback."""
+    from kubeflow_tpu.parallel import MeshConfig, build_mesh
+
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(8,))
+    assert eng.kernel == "gather"          # auto on CPU
+    mesh = build_mesh(MeshConfig(tensor=2))
+    eng_tp = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                       prefill_buckets=(8,), mesh=mesh)
+    assert eng_tp.kernel == "gather"       # auto under a mesh
+    with pytest.raises(ValueError, match="pallas"):
+        LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                  prefill_buckets=(8,), mesh=mesh, kernel="pallas")
+    with pytest.raises(ValueError, match="kernel"):
+        LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                  prefill_buckets=(8,), kernel="bogus")
+
+
 def test_sampled_decode_variant_compiles_and_runs(tiny):
     """temperature>0 exercises the NON-greedy decode program (the full
     top-k/top-p sort inside the scan) — the greedy_only static fast path

@@ -1,0 +1,167 @@
+"""Block-resident paged GQA decode kernel vs the gather reference oracle.
+
+Runs the kernel in interpret mode on CPU (SURVEY.md §4: accelerator logic
+must be testable without accelerators) — the SAME kernel logic compiles
+for TPU, where it is the LLMEngine's default decode path and is timed
+against the gather path every bench run (bench.py decode roofline).
+
+Tolerances follow tests/test_pallas_attention.py: 2e-5 for f32 inputs,
+2e-2 for bf16.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.ops.attention import decode_attention
+from kubeflow_tpu.ops.pallas_paged_attention import paged_decode_attention
+from kubeflow_tpu.serving import paged_kv
+
+
+def _pool_case(key, b, h, kvh, d, bs, nbp, kv_len, dtype=jnp.float32,
+               num_blocks=None):
+    """Random q/pools plus a block table assigning each slot ``nlive``
+    distinct (permuted) pool blocks for its ``kv_len`` rows."""
+    rng = np.random.default_rng(int(jax.random.key_data(key)[-1]))
+    nb = num_blocks or (b * nbp + 1)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), dtype)
+    kp = jnp.asarray(rng.standard_normal((nb, bs, kvh, d)), dtype)
+    vp = jnp.asarray(rng.standard_normal((nb, bs, kvh, d)), dtype)
+    tables = np.zeros((b, nbp), np.int32)
+    perm = rng.permutation(np.arange(1, nb))
+    i = 0
+    for s in range(b):
+        nlive = -(-int(kv_len[s]) // bs)
+        tables[s, :nlive] = perm[i:i + nlive]
+        i += nlive
+    return q, kp, vp, jnp.asarray(tables), jnp.asarray(kv_len, jnp.int32)
+
+
+def _gather_ref(q, kp, vp, tables, kv_len):
+    k_view = kp[tables].reshape(q.shape[0], -1, *kp.shape[2:])
+    v_view = vp[tables].reshape(q.shape[0], -1, *vp.shape[2:])
+    return decode_attention(q[:, None], k_view, v_view, kv_len)[:, 0]
+
+
+def _assert_parity(case, rtol=2e-5, atol=2e-5):
+    q, kp, vp, tables, kv_len = case
+    out = paged_decode_attention(q, kp, vp, tables, kv_len, interpret=True)
+    ref = _gather_ref(q, kp, vp, tables, kv_len)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        rtol=rtol, atol=atol)
+
+
+def test_head_dim_64_groups_2():
+    """The proxy shape the stock pallas paged-attention kernel refuses to
+    lower: head_dim 64, two query heads per KV head."""
+    kv_len = [1, 7, 16, 17, 64]   # fresh, partial, exact-block, cross, full
+    _assert_parity(_pool_case(jax.random.key(0), b=5, h=4, kvh=2, d=64,
+                              bs=16, nbp=4, kv_len=kv_len))
+
+
+def test_bench_shape():
+    """llama_1b decode geometry as the serving bench runs it: H=16, KV=8,
+    D=128, block 64, arena 320 (5 blocks/slot)."""
+    kv_len = [129, 193, 250, 320]
+    _assert_parity(_pool_case(jax.random.key(1), b=4, h=16, kvh=8, d=128,
+                              bs=64, nbp=5, kv_len=kv_len))
+
+
+def test_ragged_lengths_and_idle_slots():
+    """Live lengths raggedly spread over the table, INCLUDING len=0 idle
+    slots (all-zero table rows — the kernel must leave defined, finite
+    output without touching live blocks) and len=1 fresh slots."""
+    kv_len = [0, 1, 5, 8, 9, 24, 0, 13]
+    case = _pool_case(jax.random.key(2), b=8, h=4, kvh=2, d=32,
+                      bs=8, nbp=3, kv_len=kv_len)
+    q, kp, vp, tables, kv_len_j = case
+    out = paged_decode_attention(q, kp, vp, tables, kv_len_j,
+                                 interpret=True)
+    ref = _gather_ref(q, kp, vp, tables, kv_len_j)
+    assert bool(jnp.isfinite(out).all())
+    # live slots must match the oracle exactly; idle (len 0) slots are
+    # never read downstream (the engine masks them), only defined-ness
+    # matters there
+    live = np.asarray(kv_len) > 0
+    np.testing.assert_allclose(np.asarray(out)[live], np.asarray(ref)[live],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_shared_prefix_blocks():
+    """Two slots whose tables point at the SAME pool blocks (the prefix
+    cache sharing case) must both read them correctly."""
+    q, kp, vp, tables, kv_len = _pool_case(
+        jax.random.key(3), b=2, h=4, kvh=2, d=32, bs=8, nbp=4,
+        kv_len=[24, 24])
+    shared = np.array(tables)
+    shared[1, :2] = shared[0, :2]          # share the first two blocks
+    _assert_parity((q, kp, vp, jnp.asarray(shared), kv_len))
+
+
+def test_bf16_pool():
+    q, kp, vp, tables, kv_len = _pool_case(
+        jax.random.key(4), b=3, h=4, kvh=2, d=64, bs=16, nbp=2,
+        kv_len=[9, 16, 30], dtype=jnp.bfloat16)
+    out = paged_decode_attention(q, kp, vp, tables, kv_len, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _gather_ref(q, kp, vp, tables, kv_len)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_rejects_bad_shapes():
+    q, kp, vp, tables, kv_len = _pool_case(
+        jax.random.key(5), b=2, h=4, kvh=2, d=32, bs=8, nbp=2,
+        kv_len=[4, 4])
+    with pytest.raises(ValueError, match="multiple"):
+        paged_decode_attention(q[:, :3], kp, vp, tables, kv_len,
+                               interpret=True)
+    with pytest.raises(ValueError, match="head_dim"):
+        paged_decode_attention(q[..., :16], kp, vp, tables, kv_len,
+                               interpret=True)
+
+
+def test_decode_step_block_boundary_crossing():
+    """Full paged_decode_step parity, kernel vs gather, over decode steps
+    in which one slot's length crosses a block boundary (7 -> 8 -> 9 with
+    block_size 8: the write cursor moves to a new table block mid-decode)
+    while another slot sits idle at len 0."""
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    pk = paged_kv.PagedKV(cfg=cfg, max_batch=3, max_seq=32, block_size=8,
+                          num_blocks=13)
+    assert pk.reserve(0, 7, 8) is not None
+    assert pk.reserve(2, 3, 8) is not None      # slot 1 stays idle
+    cache_g = jax.tree.map(jnp.copy, pk.cache)
+    cache_g["len"] = jnp.asarray([7, 0, 3], jnp.int32)
+    cache_p = jax.tree.map(jnp.copy, cache_g)
+    tables = jnp.asarray(pk.tables)
+    tok = jnp.asarray([5, 0, 9], jnp.int32)
+    for _ in range(3):
+        lg, cache_g = paged_kv.paged_decode_step(
+            params, tok, cfg, cache_g, tables, kernel="gather")
+        lp, cache_p = paged_kv.paged_decode_step(
+            params, tok, cfg, cache_p, tables, kernel="pallas")
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lp),
+                                   rtol=1e-4, atol=1e-4)
+        tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(cache_g["len"]),
+                                  np.asarray(cache_p["len"]))
+    # the pools themselves stayed in lockstep (same scatter, no view)
+    np.testing.assert_allclose(np.asarray(cache_g["k"]),
+                               np.asarray(cache_p["k"]), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_kernel_resolution():
+    """"auto" resolves to gather off-TPU; an explicit "pallas" holds on
+    CPU (interpret mode) so the suite exercises the real kernel logic."""
+    assert paged_kv._resolve_decode_kernel("auto") == "gather"
+    assert paged_kv._resolve_decode_kernel("pallas") == "pallas"
+    assert paged_kv._resolve_decode_kernel("gather") == "gather"
+    with pytest.raises(ValueError, match="kernel"):
+        paged_kv._resolve_decode_kernel("vortex")
