@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube test-warmpool native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube dryrun
+ci: test-native-asan test test-kube test-warmpool dryrun
 	@echo "CI OK"
 
 test:
@@ -24,6 +24,23 @@ test-kube:
 	KFT_TEST_CLUSTER=kube $(PY) -m pytest \
 		tests/test_controller.py tests/test_gang.py \
 		tests/test_kube_cluster.py -x -q
+
+# kube-backend warm-pool e2e (fits the tier-1 timeout budget): the race/
+# claim suite, then `bench.py --cluster kube` — asserting the warm_pool
+# claim/fallback counters are IN the bench JSON so a silently-dead pool
+# regresses visibly. Two independent teeth: bench exits nonzero unless a
+# REAL warm claim happened (no pipe — a pipe would swallow its status),
+# then the JSON contract is checked from the captured file.
+test-warmpool:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_warmpool.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --cluster kube > /tmp/kft-warmpool-bench.json
+	$(PY) -c "import json; \
+		d = json.loads(open('/tmp/kft-warmpool-bench.json').read().strip().splitlines()[-1]); \
+		wp = d['extra']['warm_pool']; \
+		assert wp['claims'] >= 1, ('no warm claim happened', d); \
+		assert wp['fallbacks'] >= 1, ('cold fallback not counted', d); \
+		assert d['extra']['warm_claim']['phases']['imports'] < 1.0, d; \
+		print('warm-pool bench OK:', json.dumps(wp))"
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -6,4 +6,6 @@ from kubeflow_tpu.controller.gang import GangScheduler, PodGroup, SlicePool
 from kubeflow_tpu.controller.operator import Metrics, Operator
 from kubeflow_tpu.controller.fake_apiserver import FakeKubeApiServer
 from kubeflow_tpu.controller.kube import KubeCluster
+from kubeflow_tpu.controller.kubelet import FakeKubelet
 from kubeflow_tpu.controller.reconciler import JobController, pod_name
+from kubeflow_tpu.controller.warmpool import WarmPoolController

@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--heartbeat-dir", default=None)
     serve.add_argument("--heartbeat-timeout", type=float, default=None)
     serve.add_argument("--reconcile-period", type=float, default=None)
+    serve.add_argument("--warm-pool-size", type=int, default=None,
+                       help="pre-warmed standby zygote pods kept per pool "
+                            "class on --cluster kube (0 = disabled); "
+                            "admission claims one instead of cold-starting")
     serve.add_argument("--log-dir", default=None)
     serve.add_argument("--state-dir", default=None,
                        help="durable platform state (metadata WAL, HPO "
@@ -79,8 +83,10 @@ def main(argv=None) -> int:
         "reconcile_period": args.reconcile_period,
         "log_dir": args.log_dir,
         "state_dir": args.state_dir,
+        "warm_pool_size": args.warm_pool_size,
     })
 
+    warm_pool = None
     if args.cluster == "kube":
         import os as _os
 
@@ -105,6 +111,16 @@ def main(argv=None) -> int:
                    f"{_os.environ.get('KUBERNETES_SERVICE_PORT', '443')}")
         cluster = KubeCluster(url, image=args.kube_image)
         controller = JobController(cluster)
+        if cfg.warm_pool_size > 0:
+            from kubeflow_tpu.controller.warmpool import WarmPoolController
+
+            # pre-warmed standby pods: admission claims one (fork from a
+            # node-resident zygote) instead of cold-scheduling; the
+            # operator ticks replenish/reap and exports the counters
+            warm_pool = WarmPoolController(
+                cluster, size=cfg.warm_pool_size,
+                classes=cfg.warm_pool_classes,
+                reap_s=cfg.warm_pool_reap_s, image=args.kube_image)
         # jobs live as CRs in the apiserver (the etcd role): a restarted
         # controller reloads them and adopts its existing pods (uid
         # round-trips, so the job-uid pod selector still matches)
@@ -188,6 +204,7 @@ def main(argv=None) -> int:
         auth=auth,
         dashboard=dashboard,
         advertise_url=args.advertise_url,
+        warm_pool=warm_pool,
         webui=WebUI(jobs=controller, experiments=experiments,
                     serving=serving.controller, pipelines=pipelines,
                     notebooks=notebooks, tensorboards=tensorboards),

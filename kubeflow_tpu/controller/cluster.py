@@ -173,6 +173,15 @@ class FakeCluster:
                 pod.phase = PodPhase.RUNNING
 
 
+def zygote_eligible(command: list[str]) -> bool:
+    """True when ``command`` is the ``[sys.executable, -m, module, ...]``
+    form a zygote can fork (rendezvous/zygote.py protocol). ONE rule shared
+    by the local warm pool and the kube WarmPoolController, so the two
+    backends can never silently disagree about what warm-starts."""
+    return (len(command) >= 3 and command[0] == sys.executable
+            and command[1] == "-m")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -368,9 +377,7 @@ class LocalProcessCluster:
             # never leaves it wedged Pending with a stuck _starting entry
             proc = None
             if self.warm_pool:
-                eligible = (len(pod.command) >= 3
-                            and pod.command[0] == sys.executable
-                            and pod.command[1] == "-m")
+                eligible = zygote_eligible(pod.command)
                 if eligible:
                     proc = self._zygote_spawn(pod, dict(pod.env), log_path)
                 if proc is not None:

@@ -9,6 +9,10 @@ subset the controllers use —
 - typed + generic object storage for core (``/api/v1``) and group
   (``/apis/{group}/{version}``) resources, namespaced or cluster-scoped;
 - POST (409 on exists), GET, PUT, JSON-merge PATCH, DELETE;
+- optimistic concurrency on PATCH: a patch carrying
+  ``metadata.resourceVersion`` is a compare-and-swap — mismatch returns
+  409 Conflict, exactly the real apiserver's update-conflict semantics
+  (this is what makes a warm-pod claim race have exactly one winner);
 - list with ``labelSelector=k=v,k2=v2``;
 - the ``/status`` subresource (how tests play the kubelet);
 - ``?watch=true`` chunked streaming of ADDED/MODIFIED/DELETED events with
@@ -286,6 +290,17 @@ class FakeKubeApiServer:
                     obj = store.objects.get(key)
                     if obj is None:
                         return self._err(404, "NotFound", name)
+                    # compare-and-swap: a patch that names a resourceVersion
+                    # only applies against that exact version (the claim
+                    # fence). Pop it either way — the server owns rv.
+                    want_rv = (patch.get("metadata") or {}).pop(
+                        "resourceVersion", None)
+                    if want_rv is not None and str(want_rv) != str(
+                            obj.get("metadata", {}).get(
+                                "resourceVersion", "")):
+                        return self._err(
+                            409, "Conflict",
+                            f"resourceVersion {want_rv} is stale")
                     if sub == "status":
                         _merge(obj.setdefault("status", {}),
                                patch.get("status", patch))

@@ -49,6 +49,10 @@ from kubeflow_tpu.controller.cluster import Pod, PodPhase, Service
 
 GANG_GATE = "kubeflow-tpu.org/gang"
 ENV_ANNOTATION_PREFIX = "kubeflow-tpu.org/env."
+# a claimed warm-pool standby pod records WHICH job pod identity it serves
+# (controller/warmpool.py): a restarted controller rebuilds its name-alias
+# map from this annotation alone
+CLAIMED_AS_ANNOTATION = "kubeflow-tpu.org/claimed-as"
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 _PHASES = {
@@ -196,8 +200,18 @@ class KubeCluster:
         self._cache_serving = False
         self._cache_namespace = ""          # "" = cluster-wide
         # called (event_type, pod) after each folded watch event — the
-        # daemon hangs its reconcile wakeup here
+        # daemon hangs its reconcile wakeup here. on_pod_event is the
+        # legacy single-callback slot; add_pod_event_listener supports
+        # several subscribers (two Operators sharing one KubeCluster must
+        # not silently detach each other — ADVICE r5 #1)
         self.on_pod_event = None
+        self._pod_event_subs: list = []
+        # warm-pool subsystem (controller/warmpool.py), attached by the
+        # operator: start_pod claims a pre-warmed standby pod instead of
+        # scheduling the cold one, and _claims maps the job pod NAME to
+        # the standby pod actually serving it (k8s pods cannot be renamed)
+        self.warm_pool = None
+        self._claims: dict[tuple[str, str], tuple[str, str]] = {}
 
     # ------------------------------------------------------------ http --
 
@@ -300,8 +314,33 @@ class KubeCluster:
 
     def start_pod(self, pod: Pod) -> None:
         """Gang admission: lift the scheduling gate so the scheduler may
-        place the pod, and publish late-bound env as annotations."""
+        place the pod, and publish late-bound env as annotations.
+
+        With a warm pool attached, admission first tries to CLAIM a
+        pre-warmed standby pod (label-patched into the gang, worker argv
+        delivered to its resident zygote) instead of letting the scheduler
+        place the cold one — the claim happens here, not at create time,
+        so a gang-queued job never hogs standby capacity while it waits.
+        On a successful claim the cold gated twin (never schedulable —
+        its gate was still set) is deleted and the job pod name aliases
+        to the standby pod. A dry or dead pool falls back to the normal
+        cold path below, counted by the pool."""
         key = (pod.namespace, pod.name)
+        pool = self.warm_pool
+        if pool is not None:
+            with self._lock:
+                already = key in self._claims
+            if not already and pool.eligible(pod):
+                claimed = pool.claim_and_exec(pod)
+                if claimed is not None:
+                    with self._lock:
+                        self._claims[key] = (claimed.namespace,
+                                             claimed.name)
+                    # the cold twin _ensure_pods created is dead weight:
+                    # still gated, never scheduled — remove it so the gang
+                    # is exactly the claimed pods + any cold fallbacks
+                    self.delete_pod(pod.namespace, pod.name)
+                    return
         patch: dict = {}
         with self._lock:
             if key in self._gated:
@@ -332,6 +371,28 @@ class KubeCluster:
             self._pods.pop(key, None)
             self._gated.discard(key)
             self._pushed_env.pop(key, None)
+            # a deleted standby/claimed pod takes its job-name aliases
+            # with it (aliases point AT the warm pod, keyed by job name)
+            for alias, target in list(self._claims.items()):
+                if target == key:
+                    self._claims.pop(alias, None)
+
+    def patch_pod(self, namespace: str, name: str, patch: dict,
+                  expect_rv: Optional[int] = None) -> dict:
+        """Generic JSON merge patch on a pod. ``expect_rv`` makes it a
+        compare-and-swap: the patch names that resourceVersion and the
+        apiserver 409s if the object moved — the primitive the warm-pool
+        claim race rests on (exactly one claimant wins)."""
+        body = json.loads(json.dumps(patch))
+        if expect_rv is not None:
+            body.setdefault("metadata", {})["resourceVersion"] = str(
+                expect_rv)
+        doc = self._request(
+            "PATCH", self._pod_path(namespace, name), body,
+            content_type="application/merge-patch+json")
+        if doc:
+            self._fold(doc)
+        return doc
 
     def _apply_remote(self, pod: Pod, doc: dict) -> None:
         try:
@@ -349,9 +410,19 @@ class KubeCluster:
         except (TypeError, ValueError):
             pass
         phase, exit_code = _manifest_status(doc)
+        labels = (doc.get("metadata") or {}).get("labels")
+        if labels is not None:
+            # labels are server truth and DO change at runtime here: a
+            # warm-pool claim label-patches a standby pod into the gang —
+            # every client's cache must see the pod switch selectors
+            pod.labels = dict(labels)
         gates = (doc.get("spec", {}) or {}).get("schedulingGates") or []
         if not gates:
-            # another controller replica (or this one, earlier) lifted it
+            # another controller replica (or this one, earlier) lifted it.
+            # One-way latch on `scheduled` (never un-admit from a lagging
+            # event): the kubelet role watches this bit to know the pod
+            # may run
+            pod.scheduled = True
             self._gated.discard((pod.namespace, pod.name))
         else:
             # still gated server-side — crucial for pods ADOPTED after a
@@ -385,6 +456,17 @@ class KubeCluster:
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         key = (namespace, name)
+        with self._lock:
+            target = self._claims.get(key)
+        if target is not None:
+            # warm-claim alias: the job pod's identity is served by a
+            # claimed standby pod under its own (un-renameable) name
+            got = self.get_pod(*target)
+            if got is None:
+                with self._lock:       # claimed pod gone: alias is stale
+                    if self._claims.get(key) == target:
+                        self._claims.pop(key, None)
+            return got
         if self._cache_covers(namespace):
             with self._lock:
                 return self._pods.get(key)
@@ -468,6 +550,13 @@ class KubeCluster:
         pod.gang = bool(spec.get("schedulingGates"))
         # adoption bookkeeping: what the server already has needs no push
         self._pushed_env[(pod.namespace, pod.name)] = dict(env)
+        # warm-claim adoption: a restarted controller rebuilds the job-pod
+        # name alias from the claim annotation alone
+        claimed_as = (meta.get("annotations") or {}).get(
+            CLAIMED_AS_ANNOTATION)
+        if claimed_as:
+            self._claims[(pod.namespace, claimed_as)] = (
+                pod.namespace, pod.name)
         return pod
 
     # -------------------------------------------------- service verbs --
@@ -608,18 +697,48 @@ class KubeCluster:
         finally:
             conn.close()
 
+    def add_pod_event_listener(self, cb) -> None:
+        """Subscribe to folded watch events (cb(event_type, pod)). Unlike
+        the legacy single-slot ``on_pod_event``, any number of subscribers
+        coexist — a second Operator sharing this cluster cannot silently
+        detach the first (ADVICE r5 #1)."""
+        with self._lock:
+            self._pod_event_subs.append(cb)
+
+    def remove_pod_event_listener(self, cb) -> None:
+        with self._lock:
+            try:
+                self._pod_event_subs.remove(cb)
+            except ValueError:
+                pass
+
+    def _dispatch_pod_event(self, etype: str, pod: Pod) -> None:
+        cbs = [self.on_pod_event] if self.on_pod_event is not None else []
+        with self._lock:
+            cbs += list(self._pod_event_subs)
+        for cb in cbs:
+            try:
+                cb(etype, pod)
+            except Exception:
+                pass
+
     def start_informer(self, namespace: str = "",
                        selector: dict[str, str] = {},
-                       resync_period_s: float = 30.0) -> None:
+                       resync_period_s: float = 30.0) -> bool:
         """List+watch informer (the client-go reflector role): one priming
         LIST, then a background watch keeps the cache fresh. With an empty
         selector, get_pod/list_pods switch to cache-serving — steady-state
         reconciles issue ZERO apiserver reads between pod events; a resync
         LIST every ``resync_period_s`` repairs any drift. ``on_pod_event``
-        (if set) fires after each folded event so the daemon can reconcile
-        on events instead of polling."""
+        / ``add_pod_event_listener`` subscribers fire after each folded
+        event so the daemon can reconcile on events instead of polling.
+
+        Returns True iff THIS call started the informer thread — the
+        ownership token: only the caller that got True may stop_informer()
+        (a second Operator sharing the cluster gets False and must leave
+        the running informer alone, ADVICE r5 #1)."""
         if self._informer is not None:
-            return
+            return False
         self._cache_namespace = namespace
         try:
             self._list_pods_rest(namespace, dict(selector))     # prime
@@ -651,12 +770,7 @@ class KubeCluster:
                                 from_rv=getattr(self, "_watch_rv", 0)):
                             if self._informer_stop.is_set():
                                 return
-                            cb = self.on_pod_event
-                            if cb is not None:
-                                try:
-                                    cb(etype, pod)
-                                except Exception:
-                                    pass
+                            self._dispatch_pod_event(etype, pod)
                     except Exception:
                         if self._informer_stop.wait(1.0):
                             return
@@ -678,6 +792,7 @@ class KubeCluster:
         self._informer = threading.Thread(
             target=loop, daemon=True, name="kube-informer")
         self._informer.start()
+        return True
 
     @property
     def informer_running(self) -> bool:

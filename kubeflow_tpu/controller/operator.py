@@ -107,6 +107,7 @@ class Operator:
         webui=None,
         advertise_url: Optional[str] = None,
         pipeline_client=None,
+        warm_pool=None,
     ):
         self.controller = controller
         # One lock serializes every compound mutation of controller state
@@ -174,11 +175,25 @@ class Operator:
         self.reconcile_slow_period = reconcile_slow_period
         self.informer_resync_s = informer_resync_s
         self._pod_event_wake: Optional[threading.Event] = None
+        # warm-pool subsystem (controller/warmpool.py): the operator owns
+        # the replenish tick and exports the pool counters; the cluster's
+        # start_pod consults the pool at admission
+        self.warm_pool = warm_pool
+        if warm_pool is not None:
+            if getattr(controller.cluster, "warm_pool", None) is None:
+                controller.cluster.warm_pool = warm_pool
+            serving_tickers += (self._tick_warm_pool,)
         self.serving_tickers = tuple(serving_tickers)
         self.serving_period = serving_period
         self._submit_times: dict[tuple[str, str], float] = {}
         self._first_step_seen: set[tuple[str, str]] = set()
         self._warn_offsets: dict[str, int] = {}     # warn file -> read pos
+        # worker-reported phase timestamps delivered over the heartbeat
+        # transport ((ns, job, uid, pod) -> {phase: unix_ts}); the
+        # kube-backend replacement for reading KFT_PHASES_PATH files off a
+        # shared fs. uid-scoped like the warning files: a resubmitted
+        # same-name job must not inherit a dead incarnation's stamps.
+        self.phase_reports: dict[tuple[str, str, str, str], dict] = {}
         # heartbeat transport for pods that share no filesystem with this
         # daemon (KubeCluster): inject an http URL instead of a file path;
         # the POST handler writes the SAME tracker files locally, keeping
@@ -217,6 +232,11 @@ class Operator:
                            f"?uid={pod.labels.get('job-uid', '')}")
                     pod.env.setdefault("KFT_HEARTBEAT_FILE", url)
                     pod.env.setdefault("KFT_WARNING_FILE", url)
+                    # phase timestamps ride the SAME transport: workers on
+                    # other nodes cannot write local files this daemon
+                    # reads, so the submit→first-step decomposition POSTs
+                    # here too (heartbeat_post -> phase_reports)
+                    pod.env.setdefault("KFT_PHASES_PATH", url)
                 return pod
 
             controller.pod_mutator = mutator
@@ -261,6 +281,11 @@ class Operator:
     def delete(self, ns: str, name: str) -> None:
         with self._lock:
             self.controller.delete(ns, name)
+            # drop the dead incarnation's phase stamps with it (bounded
+            # memory; a resubmission records fresh ones under its new uid)
+            for key in [k for k in self.phase_reports
+                        if k[0] == ns and k[1] == name]:
+                self.phase_reports.pop(key, None)
         if self._pod_event_wake is not None:
             self._pod_event_wake.set()
 
@@ -369,7 +394,49 @@ class Operator:
             with open(self._warning_path(job_name, pod_name, job.uid),
                       "a") as f:
                 f.write(json.dumps(warning) + "\n")
+        phases = body.get("phases")
+        if isinstance(phases, dict):
+            # submit→first-step decomposition over the wire (kube backend:
+            # no shared fs). Merge — workers re-post the whole dict per
+            # phase, and a lagging duplicate must not erase a later stamp.
+            clean = {str(k): float(v) for k, v in phases.items()
+                     if isinstance(v, (int, float))}
+            with self._lock:
+                self.phase_reports.setdefault(
+                    (ns, job_name, job.uid, pod_name), {}).update(clean)
         return True
+
+    def job_phases(self, ns: str, job_name: str) -> dict[str, dict]:
+        """Heartbeat-transported phase stamps per pod of a job — the
+        CURRENT incarnation only (the consumer bench.py decomposes cold
+        vs warm-claim from these)."""
+        job = self.controller.get(ns, job_name)
+        uid = job.uid if job is not None else None
+        with self._lock:
+            return {pod: dict(ph)
+                    for (pns, pjob, puid, pod), ph
+                    in self.phase_reports.items()
+                    if pns == ns and pjob == job_name and puid == uid}
+
+    def _tick_warm_pool(self) -> None:
+        """Replenish/reap the warm pool and export its counters — runs on
+        the serving period OUTSIDE the operator lock (pool reconcile does
+        blocking apiserver HTTP; the pool self-serializes)."""
+        pool = self.warm_pool
+        if pool is None:
+            return
+        pool.reconcile()
+        snap = pool.snapshot()
+        self.metrics.set("kft_warm_pool_standby", snap["standby"])
+        # the *_total metrics are COUNTERS: export deltas via inc() so
+        # /metrics renders them with counter TYPE (a gauge-typed _total
+        # breaks Prometheus rate()/increase())
+        last = getattr(self, "_warm_pool_exported", {})
+        for k in ("claims", "fallbacks", "dead_claims", "claim_errors",
+                  "created", "reaped"):
+            self.metrics.inc(f"kft_warm_pool_{k}_total",
+                             by=snap[k] - last.get(k, 0))
+        self._warm_pool_exported = snap
 
     def _warning_path(self, job_name: str, pod_name: str, uid: str) -> str:
         # uid-scoped: a deleted-and-resubmitted job (same names, new uid)
@@ -466,11 +533,19 @@ class Operator:
         cluster = self.controller.cluster
         if hasattr(cluster, "start_informer"):
             # kube backend: watch-fed cache serves every read between pod
-            # events, and events (not a poll timer) drive reconcile
+            # events, and events (not a poll timer) drive reconcile.
+            # Subscribe (never overwrite on_pod_event — a second Operator
+            # sharing this cluster must not detach the first) and record
+            # whether WE started the informer: only the owner stops it.
             self._pod_event_wake = threading.Event()
-            cluster.on_pod_event = (
+            self._pod_event_cb = (
                 lambda etype, pod: self._pod_event_wake.set())
-            cluster.start_informer(resync_period_s=self.informer_resync_s)
+            if hasattr(cluster, "add_pod_event_listener"):
+                cluster.add_pod_event_listener(self._pod_event_cb)
+            else:
+                cluster.on_pod_event = self._pod_event_cb
+            self._informer_owner = bool(cluster.start_informer(
+                resync_period_s=self.informer_resync_s))
         self._threads = [
             threading.Thread(target=self._reconcile_loop, daemon=True,
                              name="kft-reconcile"),
@@ -508,14 +583,20 @@ class Operator:
     def stop(self):
         self._stop.set()
         if self._pod_event_wake is not None:
-            # only stop the informer THIS operator started (start() sets
-            # _pod_event_wake exactly when it does) — a shared KubeCluster
-            # may have another owner's informer running
             self._pod_event_wake.set()       # unblock the reconcile wait
-            stop_informer = getattr(self.controller.cluster,
-                                    "stop_informer", None)
-            if stop_informer is not None:
-                stop_informer()
+            cluster = self.controller.cluster
+            cb = getattr(self, "_pod_event_cb", None)
+            if cb is not None and hasattr(cluster,
+                                          "remove_pod_event_listener"):
+                cluster.remove_pod_event_listener(cb)
+            # only the operator whose start_informer() call actually
+            # started the thread stops it — a second Operator sharing this
+            # KubeCluster must not kill the first one's informer
+            # (ADVICE r5 #1)
+            if getattr(self, "_informer_owner", False):
+                stop_informer = getattr(cluster, "stop_informer", None)
+                if stop_informer is not None:
+                    stop_informer()
         if self._httpd is not None:
             self._httpd.shutdown()
         for t in self._threads:

@@ -24,6 +24,14 @@ class PlatformConfig:
     serving_period: float = 1.0
     # gang scheduling
     gang_aging_s: float = 300.0
+    # warm pool (kube backend; controller/warmpool.py): target number of
+    # pre-warmed standby zygote pods kept per pool class (0 = disabled),
+    # the class keys to maintain, and how old a standby/consumed pod may
+    # grow before it is reaped and replaced
+    warm_pool_size: int = 0
+    warm_pool_classes: list[str] = dataclasses.field(
+        default_factory=lambda: ["default"])
+    warm_pool_reap_s: float = 600.0
     # paths
     state_dir: str = "/tmp/kft-state"
     log_dir: str = "/tmp/kft-pods"

@@ -16,21 +16,42 @@ import sys
 def _phase(phases: dict, name: str) -> None:
     """Record a named absolute timestamp; flushed to KFT_PHASES_PATH so the
     operator/bench can decompose submit->first-step into pod spawn /
-    imports / rendezvous / compile+step (BASELINE.md row 2)."""
+    imports / rendezvous / compile+step (BASELINE.md row 2).
+
+    Two transports behind the one env value, mirroring KFT_HEARTBEAT_FILE:
+    a filesystem path (shared-fs backends) writes an atomic JSON file; an
+    http(s) URL (kube backend — the operator injects its heartbeat route)
+    POSTs {"phases": {...}} to the operator, which folds it into
+    ``Operator.phase_reports``. Whole-dict posts each time: delivery is
+    at-least-once and the receiver merges, so a lost or reordered POST
+    costs one stamp's latency, never the decomposition."""
     import time
 
     phases[name] = time.time()
     path = os.environ.get("KFT_PHASES_PATH")
-    if path:
-        import json
+    if not path:
+        return
+    import json
+
+    if path.startswith(("http://", "https://")):
+        import urllib.request
 
         try:
-            with open(f"{path}.{os.getpid()}", "w") as f:
-                json.dump(phases, f)
-            os.replace(f"{path}.{os.getpid()}",
-                       f"{path}.{os.environ.get('KFT_PROCESS_ID', '0')}")
-        except OSError:
-            pass
+            req = urllib.request.Request(
+                path, method="POST",
+                data=json.dumps({"phases": phases}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5).close()
+        except Exception:
+            pass        # like heartbeats: missed posts ARE the signal
+        return
+    try:
+        with open(f"{path}.{os.getpid()}", "w") as f:
+            json.dump(phases, f)
+        os.replace(f"{path}.{os.getpid()}",
+                   f"{path}.{os.environ.get('KFT_PROCESS_ID', '0')}")
+    except OSError:
+        pass
 
 
 def main() -> int:

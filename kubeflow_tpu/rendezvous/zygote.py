@@ -9,19 +9,38 @@ heavy modules ONCE — crucially, importing jax does NOT initialize any
 backend, so the fork inherits warm code with no device state — then forks
 a child per pod in ~milliseconds.
 
-Protocol (one unix-socket connection per pod, held open for its life):
+Protocol (one connection per pod, held open for its life):
   daemon -> zygote: one JSON line {"argv": [...], "env": {...}, "log": p}
   zygote -> daemon: {"pid": N}            after the fork
   zygote -> daemon: {"exit": code}        when the child exits
 
 The child applies the pod env (backends are uninitialized, so XLA_FLAGS /
 JAX_PLATFORMS / KFT_FORCE_PLATFORM all still take effect), points
-stdout/stderr at the pod log, and runs ``argv`` — which must be the
-``[sys.executable, "-m", module, *args]`` form (anything else is the
-daemon's cue to fall back to a plain spawn).
+stdout/stderr at the pod log (omitting ``log`` inherits the zygote's own
+stdout — the pod log, for the in-pod kube form), and runs ``argv`` —
+which must be the ``[sys.executable, "-m", module, *args]`` form
+(anything else is the daemon's cue to fall back to a plain spawn).
 
-``LocalProcessCluster(warm_pool=True)`` owns one zygote and routes
-eligible pods through it; everything else is unchanged.
+Two listener forms behind one serve():
+
+- a unix socket path — ``LocalProcessCluster(warm_pool=True)`` owns one
+  zygote per daemon and routes eligible pods through it;
+- ``tcp://host:port`` (port 0 = ephemeral) — the NODE-RESIDENT form: a
+  pre-warmed standby pod on the Kube backend runs this as its main
+  command, and the WarmPoolController claims the pod and delivers the
+  worker argv over the pod network (controller/warmpool.py). The bound
+  address is announced via ``--announce-file`` (and the
+  KFT_ZYGOTE_ANNOUNCE env the kubelet injects) so the node agent can
+  publish it as a pod annotation.
+
+SECURITY (tcp form): a fork server reachable over the pod network is an
+arbitrary-code-execution endpoint, so it is token-fenced — when
+``KFT_ZYGOTE_TOKEN`` is set (the WarmPoolController stamps a random one
+into every standby pod's env), a request whose ``"token"`` field does not
+match is refused before any fork. The token lives in the pod spec, i.e.
+the same trust domain as the pod's ServiceAccount: reading it requires
+apiserver pod-read rights, which already imply claim rights. Deployments
+should ALSO scope a NetworkPolicy to the operator, defense in depth.
 """
 
 from __future__ import annotations
@@ -75,10 +94,14 @@ def _run_child(req: dict) -> None:
     argv = req["argv"]
     env = req.get("env") or {}
     os.environ.update({k: str(v) for k, v in env.items()})
-    fd = os.open(req["log"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    os.dup2(fd, 1)
-    os.dup2(fd, 2)
-    os.close(fd)
+    if req.get("log"):
+        fd = os.open(req["log"],
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.dup2(fd, 1)
+        os.dup2(fd, 2)
+        os.close(fd)
+    # no "log": inherit the zygote's own stdout/stderr — in the standby-pod
+    # form that IS the pod log, which is where the worker should write
     if os.environ.get("KFT_FORCE_PLATFORM"):
         import jax
 
@@ -92,16 +115,34 @@ def _run_child(req: dict) -> None:
     runpy.run_module(module, run_name="__main__", alter_sys=True)
 
 
-def serve(sock_path: str) -> int:
+def serve(listen: str, announce_file: str | None = None) -> int:
     _preimport()
-    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    try:
-        os.unlink(sock_path)
-    except FileNotFoundError:
-        pass
-    srv.bind(sock_path)
+    if listen.startswith("tcp://"):
+        host, _, port = listen[len("tcp://"):].rpartition(":")
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host or "0.0.0.0", int(port or 0)))
+        bound = f"{srv.getsockname()[0]}:{srv.getsockname()[1]}"
+    else:
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            os.unlink(listen)
+        except FileNotFoundError:
+            pass
+        srv.bind(listen)
+        bound = listen
     srv.listen(64)
-    print("zygote ready", flush=True)
+    if announce_file:
+        # atomic announce: the node agent polls for this file and publishes
+        # the address as a pod annotation (a partially written file must
+        # never be read as an address)
+        tmp = f"{announce_file}.tmp"
+        with open(tmp, "w") as f:
+            f.write(bound)
+        os.replace(tmp, announce_file)
+    print(f"zygote ready on {bound}", flush=True)
+
+    token = os.environ.get("KFT_ZYGOTE_TOKEN", "")
 
     def handle(conn: socket.socket) -> None:
         try:
@@ -112,6 +153,12 @@ def serve(sock_path: str) -> int:
                     return
                 buf += chunk
             req = json.loads(buf)
+            if token and req.get("token") != token:
+                # unauthenticated peer on the pod network: refuse BEFORE
+                # any fork (see module docstring, SECURITY)
+                conn.sendall(json.dumps(
+                    {"error": "bad token"}).encode() + b"\n")
+                return
             with _fork_lock:
                 pid = os.fork()
             if pid == 0:
@@ -150,12 +197,27 @@ def serve(sock_path: str) -> int:
 
 
 def main(argv=None) -> int:
-    args = argv if argv is not None else sys.argv[1:]
+    args = list(argv if argv is not None else sys.argv[1:])
+    announce = None
+    if "--announce-file" in args:
+        i = args.index("--announce-file")
+        try:
+            announce = args[i + 1]
+        except IndexError:
+            print("--announce-file needs a path", file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    # the kubelet-injected announce convention: a node agent that spawns
+    # this pod sets KFT_ZYGOTE_ANNOUNCE so it can learn the bound address
+    # without rewriting the pod command
+    if announce is None:
+        announce = os.environ.get("KFT_ZYGOTE_ANNOUNCE") or None
     if len(args) != 1:
-        print("usage: python -m kubeflow_tpu.rendezvous.zygote <socket>",
+        print("usage: python -m kubeflow_tpu.rendezvous.zygote "
+              "<socket-path | tcp://host:port> [--announce-file PATH]",
               file=sys.stderr)
         return 2
-    return serve(args[0])
+    return serve(args[0], announce_file=announce)
 
 
 if __name__ == "__main__":

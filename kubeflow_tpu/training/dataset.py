@@ -149,13 +149,23 @@ class TokenDataset:
     # ---------------------------------------------------------- batches --
 
     def batches(self, global_batch: int,
-                start_step: int = 0) -> Iterator[dict]:
+                start_step: int = 0,
+                prefetch: int = 2) -> Iterator[dict]:
         """Infinite step-indexed batch stream: {"tokens": [local, S+1]}.
 
         Multi-host aware like ``synthetic_lm_batches``: each process yields
         its contiguous slice of the global batch. Pass this (wrapped in a
         lambda taking start_step) as ``loop.fit``'s ``batches`` callable —
         the preferred seekable form of the data-resume contract.
+
+        ``prefetch`` batches are assembled AHEAD by a background producer
+        thread (double-buffered: window gathers + np.stack overlap the
+        train step instead of serializing with it — the VERDICT Missing #4
+        gap between synthetic and file-backed MFU). ``prefetch=0`` is the
+        old synchronous path. Ordering and SIGKILL-exact resume are
+        untouched either way: batch ``i`` stays a pure function of
+        ``(corpus, seq_len, global_batch, seed, i, process)`` — the thread
+        only changes WHEN assembly happens, never WHAT step ``i`` yields.
         """
         import jax
 
@@ -166,8 +176,50 @@ class TokenDataset:
                 f"{n_proc} processes")
         local = global_batch // n_proc
         lo = jax.process_index() * local
-        step = start_step
-        while True:
-            ids = self.window_ids_for_step(step, global_batch)[lo:lo + local]
-            yield {"tokens": np.stack([self.window(int(i)) for i in ids])}
-            step += 1
+
+        def assemble(step: int) -> dict:
+            ids = self.window_ids_for_step(
+                step, global_batch)[lo:lo + local]
+            return {"tokens": np.stack(
+                [self.window(int(i)) for i in ids])}
+
+        if prefetch <= 0:
+            step = start_step
+            while True:
+                yield assemble(step)
+                step += 1
+
+        import queue
+        import threading
+
+        q: "queue.Queue[tuple]" = queue.Queue(maxsize=int(prefetch))
+        stop = threading.Event()
+
+        def produce() -> None:
+            step = start_step
+            while not stop.is_set():
+                try:
+                    item = ("ok", assemble(step))
+                except BaseException as e:  # propagate, don't die silently
+                    item = ("err", e)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if item[0] == "err":
+                    return
+                step += 1
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="kft-dataset-prefetch")
+        t.start()
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "err":
+                    raise val
+                yield val
+        finally:
+            stop.set()      # generator closed: release the producer
