@@ -117,6 +117,12 @@ def main():
     tok_per_sec = tokens_per_step * steps / dt
     mfu = tok_per_sec * cfg.flops_per_token(seq) / peak_flops(dev)
 
+    # the same trainer fed from a REAL on-disk corpus (TokenDataset mmap
+    # shards + background prefetch) — the VERDICT Next #5 tail: the
+    # file-backed input pipeline must track synthetic within noise
+    file_backed = _file_backed_train_bench(
+        trainer, mesh, cfg, global_batch, seq, steps, tok_per_sec)
+
     # serving-side decode throughput (generated tokens/s) on the same chip:
     # free the training state first (donated buffers die with the trainer)
     del trainer, m
@@ -141,6 +147,11 @@ def main():
             "step_time_ms": round(1000 * dt / steps, 2),
             "loss": round(loss, 4),
             "input_pipeline": "fresh host batch put_batch'd every step",
+            # same steps over a real on-disk corpus; the acceptance bar
+            # is within 2% of synthetic (prefetch hides the mmap reads)
+            "file_backed_tokens_per_sec_per_chip": file_backed.get(
+                "tokens_per_sec_per_chip"),
+            "file_backed": file_backed,
             "serving": serve,
             # north-star metric #2 (BASELINE.md row 2): the REAL operator
             # daemon loops drive a 2-worker JAXJob from HTTP-submit to its
@@ -167,6 +178,65 @@ def main():
             "note": "llama_1b proxy on one v5e (north star: 8B on v5p)",
         },
     }))
+
+
+def _file_backed_train_bench(trainer, mesh, cfg, global_batch: int,
+                             seq: int, steps: int,
+                             synthetic_tok_s: float) -> dict:
+    """Re-run the timed train loop fed from a file-backed TokenDataset
+    corpus: write_token_shards at setup (outside the timed window), then
+    ``batches()`` with its background-prefetch producer feeding
+    ``put_batch`` — the production input path. Reuses the already-compiled
+    step (identical batch spec), so the delta vs synthetic is PURELY the
+    input pipeline. Never sinks the bench line."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from kubeflow_tpu.training import put_batch
+    from kubeflow_tpu.training.dataset import (
+        TokenDataset, write_token_shards,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="kft-bench-corpus-")
+    gen = None
+    try:
+        # enough windows for warmup + the timed steps, one epoch
+        need = (steps + 2) * global_batch * seq + seq + 1
+        rng = np.random.default_rng(7)
+        chunk = 1 << 20
+        write_token_shards(
+            tmp,
+            (rng.integers(1, cfg.vocab_size,
+                          min(chunk, need - i), dtype=np.int32)
+             for i in range(0, need, chunk)),
+            vocab_size=cfg.vocab_size)
+        ds = TokenDataset(tmp, seq_len=seq)
+        gen = ds.batches(global_batch, start_step=0, prefetch=2)
+        m = trainer.train_step(put_batch(mesh, next(gen)))   # warm
+        float(jax.device_get(m["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m = trainer.train_step(put_batch(mesh, next(gen)))
+        float(jax.device_get(m["loss"]))
+        dt = time.perf_counter() - t0
+        tok_s = global_batch * seq * steps / dt
+        return {
+            "tokens_per_sec_per_chip": round(tok_s, 1),
+            # >= ~0.98 meets the 2%-of-synthetic acceptance bar
+            "vs_synthetic": round(tok_s / synthetic_tok_s, 4),
+            "corpus_tokens": int(ds.n_windows) * seq,
+            "prefetch": 2,
+            "input_pipeline": "TokenDataset mmap shards, "
+                              "background-prefetch batches() -> put_batch",
+        }
+    except Exception as e:                    # never sink the bench line
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        if gen is not None:
+            gen.close()     # release the prefetch producer BEFORE the
+        shutil.rmtree(tmp, ignore_errors=True)   # shards vanish under it
 
 
 def _serving_bench(dev, on_tpu: bool) -> dict:
@@ -383,6 +453,28 @@ def _kernel_parity(on_tpu: bool) -> dict:
     return out
 
 
+def _decompose_phases(ph: dict, submit_t: float) -> dict:
+    """Worker phase stamps -> submit→first-step decomposition. With the
+    executable depot in place the old monolithic ``first_step`` splits
+    into state_init (param/opt init compiles + jit setup), compile (the
+    depot-amortizable train-step lower+compile — a fetch+deserialize on a
+    hit) and first_step (step-1 execution only); workers predating the
+    compile_done stamp fall back to the merged number."""
+    out = {"pod_spawn": ph["proc_start"] - submit_t,
+           "imports": ph["imports_done"] - ph["proc_start"],
+           "rendezvous": ph["rendezvous_done"] - ph["imports_done"]}
+    if "compile_done" in ph:
+        base = ph["rendezvous_done"]
+        if "state_init_done" in ph:
+            out["state_init"] = ph["state_init_done"] - base
+            base = ph["state_init_done"]
+        out["compile"] = ph["compile_done"] - base
+        out["first_step"] = ph["first_step_done"] - ph["compile_done"]
+    else:
+        out["first_step"] = ph["first_step_done"] - ph["rendezvous_done"]
+    return {k: round(v, 2) for k, v in out.items()}
+
+
 def _submit_to_first_step_bench() -> dict:
     """North-star #2 (BASELINE.md row 2): HTTP submit -> first observed
     training step, measured by the real Operator daemon loops over a
@@ -392,7 +484,12 @@ def _submit_to_first_step_bench() -> dict:
     Runs twice — cold spawn vs the pre-imported zygote (warm_pool) — and
     decomposes each into phases from worker-side timestamps: pod spawn
     (reconcile+gang+fork/exec), imports (interpreter + jax + framework),
-    rendezvous (jax.distributed world), first_step (compile + step 1)."""
+    rendezvous (jax.distributed world), state_init (param/opt init
+    compiles), compile (train-step compile — a depot fetch+deserialize
+    when the executable depot hits), first_step (step-1 execution).
+    The operator injects KFT_DEPOT automatically (shared fs -> directory
+    depot under its heartbeat dir), so warm_resubmit exercises the
+    compile-once path on top of the XLA disk cache."""
     out = {
         "cold": _one_latency_run(False),
         "warm_pool": _one_latency_run(True),
@@ -468,18 +565,22 @@ def _one_latency_run(warm_pool: bool, resubmit: bool = False) -> dict:
             # a rename/regression that silently cold-spawns "warm" pods
             # shows up here as a nonzero count next to a cold-sized number
             res["zygote_fallbacks"] = cluster.zygote_fallbacks
-        try:
-            ph = _json.load(open(os.path.join(tmp, "phases.0")))
-            res["phases"] = {
-                "pod_spawn": round(ph["proc_start"] - submit_t, 2),
-                "imports": round(ph["imports_done"] - ph["proc_start"], 2),
-                "rendezvous": round(
-                    ph["rendezvous_done"] - ph["imports_done"], 2),
-                "first_step": round(
-                    ph["first_step_done"] - ph["rendezvous_done"], 2),
-            }
-        except (OSError, KeyError, ValueError):
-            pass
+        # per-worker decomposition + depot counters: the acceptance
+        # contract is that a depot-hit worker's compile phase collapses
+        # while the first worker's shows the one real compile — both
+        # numbers (and every fallback counter) must be IN the JSON
+        for i in range(2):
+            try:
+                ph = _json.load(open(os.path.join(tmp, f"phases.{i}")))
+                dec = _decompose_phases(ph, submit_t)
+            except (OSError, KeyError, ValueError):
+                continue
+            res["phases" if i == 0 else f"phases_worker{i}"] = dec
+            try:
+                res.setdefault("depot_workers", {})[str(i)] = _json.load(
+                    open(os.path.join(tmp, f"phases.depot.{i}")))
+            except (OSError, ValueError):
+                pass
         return res
     finally:
         op.stop()
@@ -544,12 +645,15 @@ def _project_8b_decode_v5p8(roofline: dict) -> dict:
 def _kube_latency_bench() -> dict:
     """Submit→first-step on the KUBE backend: fake apiserver (envtest
     role) + image-less kubelet actually running pod commands + the real
-    Operator daemon loops. Two measured runs — a cold pod (fresh
-    interpreter + imports) vs a warm-pool CLAIM (standby zygote pod,
-    label-patched into the gang, worker forked pre-imported) — each
-    decomposed from phase timestamps delivered over the HEARTBEAT
-    transport (no shared filesystem), with the pool's claim/fallback
-    counters in the JSON so a silently dead pool regresses visibly."""
+    Operator daemon loops. Three measured runs — a cold pod (fresh
+    interpreter + imports + the one real compile, which PUBLISHES the
+    executable to the operator depot), a warm-pool CLAIM (standby zygote
+    pod, worker forked pre-imported), and a warm RESUBMIT whose claim
+    pre-fetched the depot entry so compile degenerates to a deserialize —
+    each decomposed from phase timestamps delivered over the HEARTBEAT
+    transport (no shared filesystem), with the pool's claim/fallback AND
+    the depot's hit/publish/fallback counters in the JSON so a silently
+    dead pool or depot regresses visibly."""
     import os
     import shutil
     import tempfile
@@ -612,8 +716,14 @@ def _kube_latency_bench() -> dict:
 
     def run(name: str) -> dict:
         t = time.time()
+        # PER-JOB pod-local depot cache (pods on a real cluster do not
+        # share node disks): the warm pool pre-fetches depot entries into
+        # it at claim time; KFT_DEPOT itself — the operator HTTP route +
+        # token — is injected by the pod mutator
+        env = {**worker_env,
+               "KFT_DEPOT_CACHE": os.path.join(tmp, f"depot-cache-{name}")}
         op.submit(jax_job(name, workers=1, mesh={"data": 1},
-                          command=cmd, env=worker_env))
+                          command=cmd, env=env))
         deadline = time.time() + 180
         lat = None
         while time.time() < deadline and lat is None:
@@ -626,50 +736,67 @@ def _kube_latency_bench() -> dict:
         res = {"seconds": round(float(lat), 2)}
         for ph in op.job_phases("default", name).values():
             try:
-                res["phases"] = {
-                    "pod_spawn": round(ph["proc_start"] - t, 2),
-                    "imports": round(
-                        ph["imports_done"] - ph["proc_start"], 2),
-                    "rendezvous": round(
-                        ph["rendezvous_done"] - ph["imports_done"], 2),
-                    "first_step": round(
-                        ph["first_step_done"] - ph["rendezvous_done"], 2),
-                }
+                res["phases"] = _decompose_phases(ph, t)
                 break
             except KeyError:
                 continue
         return res
+
+    def wait_warm(timeout_s: float = 120.0) -> bool:
+        """Pool-warm barrier: a standby zygote exists AND announced —
+        outside any measured window (production daemons keep standbys
+        resident)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if any(kubelet.wait_announced(p.namespace, p.name,
+                                          timeout_s=0.2)
+                   for p in pool._pool_pods("default", "standby") if p):
+                return True
+            time.sleep(0.1)
+        return False
 
     try:
         out = {"cold": run("kube-cold")}
         # warm the pool OUTSIDE the measured window (production daemons
         # keep standbys resident): grow to 1, wait for the zygote announce
         pool.size = 1
-        deadline = time.time() + 120
-        ready = False
-        while time.time() < deadline and not ready:
-            standby = [p for p in pool._pool_pods("default", "standby")
-                       if p is not None]
-            ready = any(
-                kubelet.wait_announced(p.namespace, p.name, timeout_s=0.2)
-                for p in standby)
-            time.sleep(0.1)
-        if not ready:
+        if not wait_warm():
             out["warm_claim"] = {"error": "no standby zygote within 120s"}
         else:
             out["warm_claim"] = run("kube-warm")
+        # warm RESUBMIT: the at-scale common case — same program again,
+        # fresh warm claim. The cold run already PUBLISHED the train-step
+        # executable to the operator depot, the claim pre-fetched it into
+        # the pod-local cache, so this run's compile phase is a
+        # deserialize, not a compile (plus the XLA disk cache for the
+        # init compiles). The reconcile tick replenishes the pool first.
+        if not wait_warm():
+            out["warm_resubmit"] = {"error": "pool never replenished"}
+        else:
+            out["warm_resubmit"] = run("kube-resubmit")
         cold = out.get("cold", {}).get("seconds")
         warm = out.get("warm_claim", {}).get("seconds")
+        resub = out.get("warm_resubmit", {}).get("seconds")
         if cold and warm:
             out["speedup"] = round(cold / warm, 2)
+        if cold and resub:
+            out["resubmit_speedup"] = round(cold / resub, 2)
+        cold_compile = out.get("cold", {}).get("phases", {}).get("compile")
+        resub_compile = out.get("warm_resubmit", {}).get(
+            "phases", {}).get("compile")
+        if cold_compile and resub_compile is not None:
+            # the depot acceptance ratio: a hit's compile phase vs the
+            # one real compile (1.0 means the depot did nothing)
+            out["depot_compile_ratio"] = round(
+                resub_compile / cold_compile, 3)
         out["seconds"] = warm or cold
         out["workers"] = 1
         out["backend"] = "KubeCluster + fake apiserver + image-less kubelet"
         out["phases_transport"] = "heartbeat POST (Operator.phase_reports)"
-        # the acceptance contract: pool counters IN the bench JSON
+        # the acceptance contract: pool AND depot counters IN the bench
+        # JSON (server-side publishes/hits + worker-reported fallbacks)
         out["warm_pool"] = pool.snapshot()
-        # note: warm_claim reuses the cold run's XLA compile cache — the
-        # at-scale resubmit case; phases split compile out as first_step
+        out["depot"] = op.depot_metrics()
         return out
     except Exception as e:                    # never sink the bench line
         return {"error": f"{type(e).__name__}: {e}"}
@@ -700,13 +827,18 @@ def kube_main():
         "unit": "s",
         "extra": out,
     }))
-    # a bench that lost its pool counters, never claimed, or whose runs
-    # errored must fail loudly here, not pass silently through CI — a
-    # zero exit means A REAL WARM CLAIM HAPPENED
+    # a bench that lost its pool counters, never claimed, never published
+    # a depot entry, or whose runs errored must fail loudly here, not
+    # pass silently through CI — a zero exit means A REAL WARM CLAIM and
+    # A REAL DEPOT PUBLISH both happened, and the resubmit's phases carry
+    # the compile split
     ok = ("error" not in out
           and out.get("warm_pool", {}).get("claims", 0) >= 1
           and "error" not in out.get("cold", {})
-          and "error" not in out.get("warm_claim", {}))
+          and "error" not in out.get("warm_claim", {})
+          and "error" not in out.get("warm_resubmit", {})
+          and out.get("depot", {}).get("kft_depot_publishes_total", 0) >= 1
+          and "compile" in out.get("warm_resubmit", {}).get("phases", {}))
     return 0 if ok else 1
 
 

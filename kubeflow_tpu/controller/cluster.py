@@ -262,7 +262,8 @@ class LocalProcessCluster:
     plain spawn."""
 
     def __init__(self, log_dir: str = "/tmp/kft-pods",
-                 warm_pool: bool = False):
+                 warm_pool: bool = False,
+                 depot_dir: Optional[str] = None):
         self.pods: dict[tuple[str, str], Pod] = {}
         self.procs: dict[tuple[str, str], subprocess.Popen] = {}
         self.init_procs: dict[tuple[str, str], subprocess.Popen] = {}
@@ -279,6 +280,16 @@ class LocalProcessCluster:
         # an entrypoint rename silently regressing submit latency is
         # exactly the kind of thing this counter surfaces (bench reads it)
         self.zygote_fallbacks = 0
+        # executable depot (parallel/depot.py, shared-directory form):
+        # pods on this backend share a filesystem, so compile-once is one
+        # directory away. warm_pool implies it — both are the same
+        # submit→first-step lever; an Operator-injected KFT_DEPOT (its
+        # pod mutator runs first) takes precedence via setdefault.
+        if depot_dir is None and warm_pool:
+            depot_dir = os.path.join(log_dir, "depot")
+        self.depot_dir = depot_dir
+        if depot_dir:
+            os.makedirs(depot_dir, exist_ok=True)
         os.makedirs(log_dir, exist_ok=True)
         if warm_pool:
             # eager, non-blocking spawn: the zygote imports while the
@@ -365,6 +376,8 @@ class LocalProcessCluster:
                     or key in self._starting:
                 return
             self._starting.add(key)
+        if self.depot_dir:
+            pod.env.setdefault("KFT_DEPOT", self.depot_dir)
         env = dict(os.environ)
         env.update(pod.env)
         log = open(os.path.join(self.log_dir, f"{pod.name}.log"), "wb")

@@ -27,6 +27,9 @@ from typing import Optional
 from kubeflow_tpu.api.types import Condition, ConditionType, from_yaml, to_yaml
 from kubeflow_tpu.controller.heartbeat import FileHeartbeatTracker, check_heartbeats
 from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.parallel.depot import (
+    DEPOT_REPLACE_HEADER, DEPOT_TOKEN_HEADER,
+)
 
 
 class Metrics:
@@ -108,6 +111,7 @@ class Operator:
         advertise_url: Optional[str] = None,
         pipeline_client=None,
         warm_pool=None,
+        depot=None,
     ):
         self.controller = controller
         # One lock serializes every compound mutation of controller state
@@ -175,6 +179,25 @@ class Operator:
         self.reconcile_slow_period = reconcile_slow_period
         self.informer_resync_s = informer_resync_s
         self._pod_event_wake: Optional[threading.Event] = None
+        # executable depot (parallel/depot.py): compile-once-per-gang.
+        # The operator is the depot's home — it stores entries (under the
+        # heartbeat dir by default), serves them over the SAME HTTP
+        # transport heartbeats ride (token-fenced: a depot entry is a
+        # pickled executable, loading one is code execution), and injects
+        # the worker env contract via the pod mutator below. Workers
+        # report their hit/fallback counters over the phases POST; both
+        # sides surface as kft_depot_* /metrics.
+        if depot is None and heartbeat_dir:
+            from kubeflow_tpu.parallel.depot import DirectoryDepot
+
+            depot = DirectoryDepot(os.path.join(heartbeat_dir, "depot"))
+        self.depot = depot
+        import uuid
+
+        self.depot_token = uuid.uuid4().hex
+        # worker-reported depot counters, delta-tracked per pod so the
+        # at-least-once phases transport can re-post without double counts
+        self._depot_reported: dict[tuple[str, str, str, str], dict] = {}
         # warm-pool subsystem (controller/warmpool.py): the operator owns
         # the replenish tick and exports the pool counters; the cluster's
         # start_pod consults the pool at admission
@@ -223,6 +246,10 @@ class Operator:
                         "KFT_WARNING_FILE",
                         self._warning_path(job, pod.name,
                                            pod.labels.get("job-uid", "")))
+                    if getattr(self.depot, "path", None):
+                        # shared fs: workers read/publish the depot
+                        # directory itself — no HTTP round trip
+                        pod.env.setdefault("KFT_DEPOT", self.depot.path)
                 elif self.advertise_url:
                     # uid-scoped like the file transport: a zombie pod of
                     # a dead incarnation must not feed the new job
@@ -237,6 +264,21 @@ class Operator:
                     # reads, so the submit→first-step decomposition POSTs
                     # here too (heartbeat_post -> phase_reports)
                     pod.env.setdefault("KFT_PHASES_PATH", url)
+                    if self.depot is not None:
+                        pod.env.setdefault(
+                            "KFT_DEPOT",
+                            f"{self.advertise_url.rstrip('/')}"
+                            "/apis/v1/depot")
+                        pod.env.setdefault(
+                            "KFT_DEPOT_TOKEN", self.depot_token)
+                        # node-local cache, shared across pods on a node
+                        # (entries are content-addressed): the claim-time
+                        # pre-fetch and worker write-through both land
+                        # here — without a default, the warm pool's
+                        # pre-fetch would be inert on every deployment
+                        # that doesn't hand-set a cache dir
+                        pod.env.setdefault(
+                            "KFT_DEPOT_CACHE", "/tmp/kft-depot-cache")
                 return pod
 
             controller.pod_mutator = mutator
@@ -286,6 +328,9 @@ class Operator:
             for key in [k for k in self.phase_reports
                         if k[0] == ns and k[1] == name]:
                 self.phase_reports.pop(key, None)
+            for key in [k for k in self._depot_reported
+                        if k[0] == ns and k[1] == name]:
+                self._depot_reported.pop(key, None)
         if self._pod_event_wake is not None:
             self._pod_event_wake.set()
 
@@ -404,7 +449,69 @@ class Operator:
             with self._lock:
                 self.phase_reports.setdefault(
                     (ns, job_name, job.uid, pod_name), {}).update(clean)
+        depot = body.get("depot")
+        if isinstance(depot, dict):
+            # worker-side depot counters (hits / deserialize_failures /
+            # ...) folded into /metrics as kft_depot_worker_<k>_total —
+            # namespaced apart from the server-side publish/fetch
+            # counters. Workers post ABSOLUTE counts over an
+            # at-least-once transport, so export per-pod deltas — a
+            # re-post must not double count.
+            clean = {str(k): int(v) for k, v in depot.items()
+                     if isinstance(v, (int, float))}
+            key = (ns, job_name, job.uid, pod_name)
+            with self._lock:
+                last = self._depot_reported.setdefault(key, {})
+                for k, v in clean.items():
+                    prev = last.get(k, 0)
+                    # v < prev = the pod restarted and its counters
+                    # reset (same name+uid): Prometheus counter-reset
+                    # semantics — the new absolute IS the delta, not
+                    # swallowed under the old high-water mark
+                    delta = v if v < prev else v - prev
+                    if delta > 0:
+                        self.metrics.inc(
+                            f"kft_depot_worker_{k}_total", by=delta)
+                    last[k] = v
         return True
+
+    # ---------------- executable depot (the depot-server role) ----------
+
+    def depot_authorized(self, token: Optional[str]) -> bool:
+        """Depot routes are worker-facing like heartbeats, but NOT open: a
+        depot entry is a pickled executable, so reads and writes require
+        the operator-injected KFT_DEPOT_TOKEN (the zygote-token trust
+        model — possession implies pod-spec read rights)."""
+        return self.depot is not None and token == self.depot_token
+
+    def depot_fetch(self, key: str) -> Optional[bytes]:
+        try:
+            data = self.depot.get(key)
+        except (OSError, ValueError):
+            data = None
+        self.metrics.inc("kft_depot_server_hits_total" if data is not None
+                         else "kft_depot_server_misses_total")
+        return data
+
+    def depot_publish(self, key: str, data: bytes,
+                      replace: bool = False) -> bool:
+        """``replace``: the publisher fetched the existing entry and
+        proved it bad (corrupt/tombstone/skew) — let it heal the key
+        instead of pinning the bad entry forever behind first-wins."""
+        try:
+            published = self.depot.put(key, data, replace=replace)
+        except (OSError, ValueError):
+            return False
+        self.metrics.inc("kft_depot_publishes_total" if published
+                         else "kft_depot_publish_races_total")
+        return published
+
+    def depot_metrics(self) -> dict:
+        """Every kft_depot_* counter (server- and worker-reported) — the
+        bench JSON's depot section."""
+        with self.metrics._lock:
+            return {k: v for k, v in self.metrics._counters.items()
+                    if k.startswith("kft_depot_")}
 
     def job_phases(self, ns: str, job_name: str) -> dict[str, dict]:
         """Heartbeat-transported phase stamps per pod of a job — the
@@ -433,7 +540,8 @@ class Operator:
         # breaks Prometheus rate()/increase())
         last = getattr(self, "_warm_pool_exported", {})
         for k in ("claims", "fallbacks", "dead_claims", "claim_errors",
-                  "created", "reaped"):
+                  "created", "reaped", "prefetched_entries",
+                  "prefetch_errors"):
             self.metrics.inc(f"kft_warm_pool_{k}_total",
                              by=snap[k] - last.get(k, 0))
         self._warm_pool_exported = snap
@@ -743,6 +851,47 @@ def _make_http_server(op: Operator, port: int,
                 return False
             return True
 
+        def _depot_path(self) -> Optional[str]:
+            # /apis/v1/depot -> ""   /apis/v1/depot/{key} -> key
+            parts = self.path.strip("/").split("/")
+            if parts[:3] == ["apis", "v1", "depot"] and len(parts) <= 4:
+                return parts[3] if len(parts) == 4 else ""
+            return None
+
+        def _send_bytes(self, code: int, data: bytes,
+                        ctype: str = "application/octet-stream"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _depot(self, method: str, key: str, raw: bytes = b""):
+            """Executable-depot routes (see Operator.depot_authorized for
+            the trust model). GET "" lists keys (pre-fetch sync), GET key
+            streams the entry, POST key publishes first-wins."""
+            if not op.depot_authorized(
+                    self.headers.get(DEPOT_TOKEN_HEADER)):
+                return self._send(
+                    403, '{"error": "depot token required"}')
+            if method == "GET" and not key:
+                try:
+                    keys = op.depot.keys()
+                except Exception:
+                    keys = []
+                return self._send(200, json.dumps({"keys": keys}))
+            if method == "GET":
+                data = op.depot_fetch(key)
+                if data is None:
+                    return self._send(404, '{"error": "no entry"}')
+                return self._send_bytes(200, data)
+            if not key:
+                return self._send(400, '{"error": "publish needs a key"}')
+            published = op.depot_publish(
+                key, raw,
+                replace=self.headers.get(DEPOT_REPLACE_HEADER) == "1")
+            return self._send(200, json.dumps({"published": published}))
+
         def _heartbeat_path(self):
             # /apis/v1/namespaces/{ns}/jobs/{job}/pods/{pod}/heartbeat[?uid=]
             from urllib.parse import parse_qs
@@ -831,6 +980,11 @@ def _make_http_server(op: Operator, port: int,
                 return self._send(200, "ok", "text/plain")
             if self.path == "/metrics":
                 return self._send(200, op.metrics.render(), "text/plain")
+            dp = self._depot_path()
+            if dp is not None:
+                # worker-facing like the heartbeat sink (workers hold no
+                # bearer tokens) — fenced by the depot token instead
+                return self._depot("GET", dp)
             if not self._authorized():
                 return
             if self._maybe_proxy("GET"):
@@ -932,6 +1086,10 @@ def _make_http_server(op: Operator, port: int,
                 return self._send(200 if ok else 404,
                                   '{"ok": true}' if ok
                                   else '{"error": "unknown job or uid"}')
+            dp = self._depot_path()
+            if dp is not None:
+                # BEFORE the UTF-8 decode: depot entries are binary
+                return self._depot("POST", dp, raw)
             if not self._authorized():
                 return
             # proxy BEFORE decoding: inference payloads may be binary

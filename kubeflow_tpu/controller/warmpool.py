@@ -146,6 +146,11 @@ class WarmPoolController:
         self.claim_errors = 0    # non-conflict apiserver/dial failures
         self.created = 0
         self.reaped = 0
+        # executable-depot pre-fetch at claim time (parallel/depot.py):
+        # entries synced into the claimed pod's local cache before the
+        # worker forks, so its compile phase is a cache read
+        self.prefetched_entries = 0
+        self.prefetch_errors = 0
 
     # ------------------------------------------------------ eligibility --
 
@@ -243,6 +248,8 @@ class WarmPoolController:
             "claim_errors": self.claim_errors,
             "created": self.created,
             "reaped": self.reaped,
+            "prefetched_entries": self.prefetched_entries,
+            "prefetch_errors": self.prefetch_errors,
             "standby": self.standby_count(),
         }
 
@@ -321,6 +328,15 @@ class WarmPoolController:
              for e in (c.get("env") or [])
              if e.get("name") == "KFT_ZYGOTE_TOKEN"), "")
         env = self._exec_env(job_pod, cand)
+        # the claimed standby pre-fetches the executable depot in the
+        # BACKGROUND: started before the exec RPC so it normally beats
+        # the worker to its first depot read (the worker pays fork +
+        # imports + state init first), but never blocking admission on
+        # entry transfer — a worker whose cache is still cold simply
+        # fetches the remote itself (LocalCacheDepot writes through)
+        threading.Thread(target=self._prefetch_depot, args=(env,),
+                         daemon=True,
+                         name=f"depot-prefetch-{cand.name}").start()
         watcher = self._exec(addr, cand, job_pod.command, env, token)
         if watcher is None:
             # claimed a corpse (zygote died between claim and use): make
@@ -342,6 +358,28 @@ class WarmPoolController:
         cand.env.update(env)
         cand.scheduled = True
         return cand
+
+    def _prefetch_depot(self, env: dict, limit: int = 8) -> None:
+        """Sync the newest executable-depot entries into the pod-local
+        cache named by the worker env (KFT_DEPOT_CACHE). In this
+        single-binary architecture the controller performs the fetch (the
+        cache dir is host-shared, like the kubelet's announce file); on a
+        real cluster the standby pod's node agent would run the same sync
+        against its own disk. Runs on a daemon thread off the claim path
+        (entries can be large), best-effort and counted — a depot that
+        cannot be synced costs the claim nothing but the fast path."""
+        if not env.get("KFT_DEPOT") or not env.get("KFT_DEPOT_CACHE"):
+            return
+        try:
+            from kubeflow_tpu.parallel.depot import depot_from_env
+
+            depot = depot_from_env(env)     # LocalCacheDepot: get() =
+            for key in depot.keys()[:limit]:  # write-through to the cache
+                if depot.cache.get(key) is None \
+                        and depot.get(key) is not None:
+                    self.prefetched_entries += 1
+        except Exception:
+            self.prefetch_errors += 1
 
     def _exec_env(self, job_pod: Pod, cand: Pod) -> dict:
         """The worker env, with heartbeat/phase URLs re-pointed at the

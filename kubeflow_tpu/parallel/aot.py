@@ -20,7 +20,10 @@ HBM budgets are per-chip device memory: v5p = 95 GB, v5e = 16 GB.
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
+import os
+import re
 from typing import Any, Optional
 
 import jax
@@ -40,6 +43,19 @@ CHIP_SPECS = {
     "v5e": (197e12, 819e9),
     "v4": (275e12, 1228e9),
 }
+
+# aggregate per-chip interconnect bandwidth, bytes/s. ICI: the public
+# per-chip figures (v5p 4,800 Gbps, v5e 1,600 Gbps, v4 2,400 Gbps). DCN
+# (multi-slice, per chip): a stated planning assumption — data-center
+# fabric per v5p host is ~100-200 Gbps shared by 4 chips; 25 GB/s/chip is
+# deliberately optimistic-but-plausible and is named in est_basis so the
+# projection's weakest input is visible, not buried.
+ICI_BW_PER_CHIP = {"v5p": 600e9, "v5e": 200e9, "v4": 300e9}
+DCN_BW_PER_CHIP = 25e9
+# fraction of collective time assumed hidden under compute (XLA overlaps
+# FSDP all-gathers with the matmuls that consume them; latency-bound
+# tails and the last layer's collectives are not hideable)
+COLLECTIVE_OVERLAP = 0.75
 
 
 @dataclasses.dataclass
@@ -64,15 +80,29 @@ class ScaleProof:
     #   flops; when HLO flops exceed the floor (remat recompute captured)
     #   they are used.
     # - est_mfu: projection = the measured single-chip MFU of the SAME
-    #   trainer recipe (0.587, Llama-1B, remat=dots+pallas on v5e) scaled
-    #   by the config's remat recompute factor (dots ~1.0, full ~0.75:
-    #   one extra forward of ~2ND per 6ND). ICI/DCN collectives are NOT
-    #   modeled — est_mfu is a projection, not a measurement.
+    #   trainer recipe (from the latest BENCH artifact — see
+    #   measured_single_chip_mfu) scaled by the config's remat recompute
+    #   factor (dots ~1.0, full ~0.75: one extra forward of ~2ND per
+    #   6ND), then derated by the exposed-collective bubble: per-chip
+    #   all-gather/reduce-scatter/all-reduce wire bytes (max of the
+    #   HLO-parsed ops and the analytic FSDP floor) over ICI/DCN
+    #   bandwidth, COLLECTIVE_OVERLAP assumed hidden under compute.
+    #   est_mfu is a projection, not a measurement.
     est_step_floor_s: float = 0.0
     est_mfu: float = 0.0
-    est_step_s: float = 0.0            # model_flops/(chips*peak*est_mfu)
+    est_step_s: float = 0.0            # compute projection + exposed comms
     est_tokens_per_sec_per_chip: float = 0.0
     est_basis: str = ""
+    # collective model (training proofs): per-chip wire bytes per step =
+    # max(HLO-parsed collective ops, analytic FSDP floor), split by the
+    # fabric they traverse; coll_bubble_s is the part NOT hidden under
+    # compute (COLLECTIVE_OVERLAP), already folded into est_step_s
+    coll_ici_gb: float = 0.0
+    coll_dcn_gb: float = 0.0
+    coll_s: float = 0.0
+    coll_bubble_s: float = 0.0
+    # est_mfu restated against the BASELINE >=0.40 target (>1 = margin)
+    margin_vs_target: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -126,11 +156,19 @@ def aot_train_proof(
     seq: int = 8192,
     name: str = "train",
     hbm_gb: Optional[float] = None,
+    depot=None,
 ) -> ScaleProof:
     """Compile the FULL train step (fwd+bwd+adam, grad-accum off) for the
     target topology and report per-chip HBM. Uses the production Trainer —
     the same step the JAXJob worker runs — so the proof covers the real
-    remat/sharding choices, not a stand-in."""
+    remat/sharding choices, not a stand-in.
+
+    ``depot``: an executable depot (``parallel/depot.py``) to publish the
+    compiled step to — the operator-ahead-of-submit form of compile-once:
+    run the proof before the job and gang workers whose program,
+    topology and toolchain fingerprint-match fetch instead of compiling.
+    (Entries are platform-keyed; serialize failures degrade to a counted
+    plain compile, like every depot path.)"""
     from kubeflow_tpu.training import Trainer, TrainerConfig, lm_loss_fn
 
     devices = topology_devices(topology, num_slices)
@@ -149,30 +187,202 @@ def aot_train_proof(
     opt_shape = jax.eval_shape(trainer.optimizer.init, params_shape)
     params_in = _sds(params_shape, trainer.param_shardings)
     opt_in = _sds(opt_shape, trainer.opt_shardings)
+    # [batch, seq+1]: the lm_loss batch contract every worker lowers
+    # (inputs tokens[:, :-1], targets [:, 1:]) — the model really runs
+    # on ``seq`` tokens, matching the flops accounting below, and the
+    # depot fingerprint matches what a gang worker of this config
+    # computes (the ahead-of-submit publish would never hit otherwise)
     batch_in = {"tokens": jax.ShapeDtypeStruct(
-        (batch, seq), jnp.int32, sharding=trainer.batch_sharding)}
+        (batch, seq + 1), jnp.int32, sharding=trainer.batch_sharding)}
     lowered = trainer.lower_step(params_in, opt_in, batch_in)
-    compiled = lowered.compile()
+    if depot is not None:
+        from kubeflow_tpu.parallel.depot import load_or_compile
+
+        compiled, _ = load_or_compile(lowered, depot, mesh=mesh)
+    else:
+        compiled = lowered.compile()
     flops = cfg.flops_per_token(seq) * batch * seq
     kind = topology.split(":", 1)[0]
+    param_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(params_shape))
     proof = _analyze(name, topology, num_slices, mesh, compiled,
                      hbm_gb or HBM_PER_CHIP_GB.get(kind, 95.0), flops)
     _estimate_roofline(proof, compiled, kind, flops, batch * seq,
-                       getattr(cfg, "remat", None))
+                       getattr(cfg, "remat", None),
+                       param_bytes=param_bytes)
     return proof
 
 
+#  fallback only — the projection prefers the LATEST bench artifact (see
+# measured_single_chip_mfu); this constant is the round-4-era measurement
+# kept for environments with no BENCH_r*.json next to the repo
 MEASURED_SINGLE_CHIP_MFU = 0.587   # Llama-1B, remat=dots + pallas, v5e
 _REMAT_MFU_FACTOR = {"dots": 1.0, "full": 0.75, "none": 1.0, None: 1.0}
 
 
+def measured_single_chip_mfu(root: Optional[str] = None) -> tuple[float, str]:
+    """(mfu, provenance) from the newest ``BENCH_r*.json`` driver
+    artifact, so the projection tracks what the bench ACTUALLY measured
+    instead of a baked constant that drifts (VERDICT Weak #3).
+
+    Artifacts carry either a ``parsed`` copy of the bench line or only a
+    truncated ``tail`` — both are tried (newest round first); anything
+    unreadable, or an mfu outside (0, 1], falls through. Search root:
+    ``KFT_BENCH_DIR`` env, else the repo root this package sits in."""
+    root = root or os.environ.get("KFT_BENCH_DIR") or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    def round_no(path: str) -> int:
+        # numeric, not lexicographic: r100 > r99, unpadded r9 stays r9
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                       key=round_no, reverse=True):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        mfu = None
+        try:
+            mfu = float(doc["parsed"]["extra"]["mfu"])
+        except (KeyError, TypeError, ValueError):
+            m = re.search(r'"mfu":\s*([0-9.eE+-]+)', doc.get("tail") or "")
+            if m:
+                try:
+                    mfu = float(m.group(1))
+                except ValueError:
+                    mfu = None
+        if mfu is not None and 0.0 < mfu <= 1.0:
+            return mfu, os.path.basename(path)
+    return MEASURED_SINGLE_CHIP_MFU, "baked-in fallback (no bench artifact)"
+
+
+# ------------------------------------------------- collective modeling --
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](T\()?")
+# the RESULT-shape region between `=` and the op call: instruction NAMES
+# also contain the op string (%all-reduce.2 = f32[] all-reduce(...)), so
+# anchoring on `=` is what keeps the shape parse on the right side;
+# -start async halves carry the groups/shape, -done halves match nothing
+_COLL_LINE_RE = re.compile(
+    r"=\s*(?P<lhs>.*?)\s*"
+    r"(?P<op>all-gather|reduce-scatter|all-reduce|all-to-all|"
+    r"collective-permute)(?:-start)?\(")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def hlo_collective_bytes(hlo_text: str, devices_per_slice: int,
+                         n_devices: int = 0) -> dict:
+    """Per-chip wire bytes of every collective in an HLO module, split by
+    the fabric it crosses (a replica group whose members span slices
+    rides DCN). Wire-byte model per participant of a g-way group moving a
+    B-byte result: all-gather B*(g-1)/g, reduce-scatter B*(g-1) (result
+    is the shard), all-reduce 2B*(g-1)/g, all-to-all B*(g-1)/g,
+    collective-permute B. An op with EMPTY or absent replica_groups
+    spans all participants (XLA's all-devices spelling) — ``n_devices``
+    sets its group size so those ops aren't silently dropped.
+
+    CAVEAT (same one the flops floor documents): XLA HLO text does NOT
+    multiply scan/while bodies by trip count, so collectives inside a
+    scanned layer stack appear ONCE — callers take max() with the
+    analytic model below rather than trusting this parse alone."""
+    ici = dcn = 0.0
+    ops = 0
+    for line in hlo_text.splitlines():
+        m_op = _COLL_LINE_RE.search(line)
+        if m_op is None:
+            continue
+        op = m_op.group("op")
+        shapes = _SHAPE_RE.findall(m_op.group("lhs"))
+        if not shapes:
+            continue
+        payload = max(_shape_bytes(d, s) for d, s in shapes)
+        # default: empty/absent replica_groups = ONE group of every
+        # participant, the all-devices spelling some channel-based ops
+        # use — not a droppable parse failure
+        g = max(n_devices, 1)
+        crosses = g > max(devices_per_slice, 1)
+        m = _GROUPS_RE.search(line)
+        if m:
+            groups = [
+                [int(x) for x in grp.split(",") if x.strip()]
+                for grp in re.findall(r"\{([0-9,\s]*)\}", m.group(0))]
+            groups = [grp for grp in groups if grp]
+            if groups:
+                g = max(len(grp) for grp in groups)
+                crosses = any(
+                    len({i // max(devices_per_slice, 1) for i in grp}) > 1
+                    for grp in groups)
+        else:
+            m = _IOTA_RE.search(line)
+            if m:
+                g = int(m.group(2))
+                # iota-with-transpose = strided groups: the multi-slice
+                # mesh puts the slice axis outermost, so strided groups
+                # are the ones that cross it
+                crosses = bool(m.group(4)) or g > devices_per_slice
+        if g <= 1:
+            continue
+        if op == "all-gather":
+            wire = payload * (g - 1) / g
+        elif op == "reduce-scatter":
+            wire = payload * (g - 1)
+        elif op == "all-reduce":
+            wire = 2 * payload * (g - 1) / g
+        elif op == "all-to-all":
+            wire = payload * (g - 1) / g
+        else:
+            wire = payload
+        ops += 1
+        if crosses:
+            dcn += wire
+        else:
+            ici += wire
+    return {"ici_bytes": ici, "dcn_bytes": dcn, "ops": ops}
+
+
+def analytic_fsdp_collective_bytes(param_bytes: int,
+                                   mesh_axes: dict) -> dict:
+    """The analytic floor the HLO parse is max'ed with: per training step
+    an FSDP-sharded model all-gathers its parameters twice (forward +
+    re-gather in backward) and reduce-scatters gradients once over the
+    fsdp axis (ICI), then all-reduces the resulting grad SHARD across the
+    dcn_data axis (DCN). Per-chip wire bytes, dtypes as stored."""
+    f = int(mesh_axes.get("fsdp", 1))
+    d = int(mesh_axes.get("dcn_data", 1))
+    ici = 3.0 * param_bytes * (f - 1) / f if f > 1 else 0.0
+    shard = param_bytes / max(f, 1)
+    dcn = 2.0 * shard * (d - 1) / d if d > 1 else 0.0
+    return {"ici_bytes": ici, "dcn_bytes": dcn}
+
+
 def _estimate_roofline(proof: ScaleProof, compiled, kind: str,
                        model_flops: float, tokens: int,
-                       remat: Optional[str]) -> None:
+                       remat: Optional[str],
+                       param_bytes: int = 0) -> None:
     """Fill the est_* fields (see ScaleProof docstring for the basis)."""
     peak, _bw = CHIP_SPECS.get(kind, CHIP_SPECS["v5p"])
     n = proof.n_devices
     hlo_flops = 0.0
+    hlo_text = ""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -180,19 +390,65 @@ def _estimate_roofline(proof: ScaleProof, compiled, kind: str,
         hlo_flops = float(ca.get("flops", 0.0))
     except Exception:
         pass
+    try:
+        hlo_text = compiled.as_text()
+    except Exception:
+        pass
     per_chip_flops = max(hlo_flops, model_flops / n)
     proof.est_step_floor_s = round(per_chip_flops / peak, 4)
-    mfu = MEASURED_SINGLE_CHIP_MFU * _REMAT_MFU_FACTOR.get(remat, 1.0)
-    proof.est_mfu = round(mfu, 4)
-    t = model_flops / n / peak / mfu
+    mfu_meas, mfu_src = measured_single_chip_mfu()
+    mfu = mfu_meas * _REMAT_MFU_FACTOR.get(remat, 1.0)
+    compute_s = model_flops / n / peak / mfu
+
+    # collectives: per-chip wire bytes per step = max(what the compiled
+    # HLO actually contains, the analytic FSDP floor) per fabric — the
+    # HLO parse counts scan bodies once (like the flops floor), the
+    # analytic model can't see TP/unexpected collectives; max() is the
+    # honest combination of two under-counts
+    per_slice = max(1, n // max(proof.num_slices, 1))
+    parsed = (hlo_collective_bytes(hlo_text, per_slice, n_devices=n)
+              if hlo_text else
+              {"ici_bytes": 0.0, "dcn_bytes": 0.0, "ops": 0})
+    if proof.num_slices <= 1:
+        # single slice: nothing crosses DCN by definition — fold any
+        # strided groups the iota heuristic flagged back into ICI
+        parsed["ici_bytes"] += parsed["dcn_bytes"]
+        parsed["dcn_bytes"] = 0.0
+    analytic = analytic_fsdp_collective_bytes(param_bytes, proof.mesh_axes)
+    ici = max(parsed["ici_bytes"], analytic["ici_bytes"])
+    dcn = max(parsed["dcn_bytes"], analytic["dcn_bytes"])
+    ici_bw = ICI_BW_PER_CHIP.get(kind, ICI_BW_PER_CHIP["v5p"])
+    coll_s = ici / ici_bw + dcn / DCN_BW_PER_CHIP
+    # at most COLLECTIVE_OVERLAP of the collective time hides under
+    # compute, and hiding is additionally capped by the compute that
+    # exists to hide it: exposed bubble = coll - min(o*coll, compute).
+    # The (1-o)*coll floor keeps the derate honest even in the
+    # compute-bound regime (latency tails and the last layer's
+    # collectives never overlap), so the collectives fold into
+    # est_step_s/est_mfu non-vacuously.
+    bubble = coll_s - min(COLLECTIVE_OVERLAP * coll_s, compute_s)
+    t = compute_s + bubble
+
+    proof.coll_ici_gb = round(ici / (1 << 30), 3)
+    proof.coll_dcn_gb = round(dcn / (1 << 30), 3)
+    proof.coll_s = round(coll_s, 4)
+    proof.coll_bubble_s = round(bubble, 4)
+    proof.est_mfu = round(model_flops / n / peak / t, 4)
     proof.est_step_s = round(t, 4)
     proof.est_tokens_per_sec_per_chip = round(tokens / t / n, 1)
+    proof.margin_vs_target = round(proof.est_mfu / 0.40, 3)
     proof.est_basis = (
-        "projection: measured 0.587 single-chip MFU (same trainer recipe) "
-        f"x remat factor {_REMAT_MFU_FACTOR.get(remat, 1.0)}; "
+        f"projection: measured {mfu_meas} single-chip MFU ({mfu_src}, "
+        "same trainer recipe) x remat factor "
+        f"{_REMAT_MFU_FACTOR.get(remat, 1.0)}; "
         "compute floor from max(model, HLO) flops / peak "
         "(XLA:TPU cost_analysis omits scan trip counts); "
-        "ICI/DCN collectives unmodeled")
+        "collectives modeled: max(HLO-parsed, analytic FSDP) wire bytes "
+        f"— {parsed['ops']} HLO collective ops, scan bodies counted once "
+        f"— over ICI {ici_bw / 1e9:.0f} GB/s/chip + DCN "
+        f"{DCN_BW_PER_CHIP / 1e9:.0f} GB/s/chip, "
+        f"{COLLECTIVE_OVERLAP:.0%} assumed compute-overlapped; "
+        "est_mfu restated vs the 0.40 target as margin_vs_target")
 
 
 # -------------------------------------------------------------- serving --

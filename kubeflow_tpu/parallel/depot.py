@@ -1,0 +1,443 @@
+"""Gang-wide compile-once executable depot: split compile from step 1.
+
+Every gang worker runs the SAME SPMD train-step program, so every worker
+paying the same XLA:TPU compile is pure waste at gang width N — and the
+round-5 decomposition showed an undecomposed ``first_step`` phase is where
+the remaining submit→first-step time lives (BASELINE.md row 2). pjit-era
+TPU stacks amortize exactly this cost by compiling once and reusing the
+serialized executable ("Scalable Training of Language Models using JAX
+pjit and TPUv4", PAPERS.md). The depot is that layer:
+
+- the FIRST gang worker (process_id 0) — or the operator ahead of submit,
+  via the ``parallel/aot.py`` lower/compile path — compiles, serializes
+  (``jax.experimental.serialize_executable``) and PUBLISHES the executable
+  under a fingerprint of (HLO hash, mesh/topology, jax+jaxlib versions,
+  backend platform);
+- every other worker, and every warm-pool resubmit, FETCHES and
+  deserializes instead of compiling. Followers (process_id > 0) wait
+  briefly for the coordinator's publish rather than racing it — gang
+  width N pays ONE compile;
+- two transports behind one ``KFT_DEPOT`` env value, mirroring
+  KFT_HEARTBEAT_FILE: a directory path (shared-fs backends) or an
+  http(s) URL (kube backend — the operator serves the depot over the
+  heartbeat transport, token-fenced by ``KFT_DEPOT_TOKEN``);
+- ``KFT_DEPOT_CACHE`` names a pod-local directory consulted before the
+  remote — the warm pool pre-fetches depot entries into it at claim time
+  so a claimed standby's worker finds the executable already on its node.
+
+FALLBACK SEMANTICS (the depot is a pure fast path, never a failure mode):
+a missing entry, a corrupt/truncated blob, a fingerprint that does not
+match (version skew), or a platform whose runtime cannot deserialize
+(the observed ``DeserializeLoadedExecutable not implemented``) all
+degrade to a counted, logged local compile. Counters travel to the
+operator over the phases transport and surface as ``kft_depot_*``
+/metrics — a depot that silently stopped hitting must regress visibly.
+
+SECURITY: a depot entry is a pickled executable — loading one is code
+execution, so the HTTP transport is token-fenced like the zygote's fork
+endpoint (``KFT_ZYGOTE_TOKEN``): the operator stamps ``KFT_DEPOT_TOKEN``
+into worker env, and requests without it are refused. Same trust domain
+as the pod spec; deployments should also scope a NetworkPolicy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+DEPOT_TOKEN_HEADER = "X-KFT-Depot-Token"
+DEPOT_REPLACE_HEADER = "X-KFT-Depot-Replace"
+_ENTRY_SUFFIX = ".kexec"
+_FORMAT = 1
+
+
+class FingerprintMismatch(Exception):
+    """Depot entry exists but was built for a different program/toolchain."""
+
+
+class DepotStats:
+    """Thread-safe monotonic counters for one worker's depot traffic.
+
+    Exported over the phases transport and folded into operator /metrics
+    as ``kft_depot_<name>_total`` — the contract that makes every
+    fallback path visible (a deserialize failure is never an error, but
+    it must never be silent either)."""
+
+    FIELDS = (
+        "hits",                  # executable fetched + deserialized
+        "cache_hits",            # served from the pod-local cache dir
+        "misses",                # no entry yet (leads to a compile)
+        "compiles",              # local compiles actually paid
+        "publishes",             # entries this worker published first
+        "publish_races",         # lost the publish race (entry appeared)
+        "deserialize_failures",  # corrupt blob / platform can't load
+        "fingerprint_mismatches",  # entry keyed right, built wrong (skew)
+        "serialize_failures",    # this platform can't serialize (tombstoned)
+        "error_entries",         # fetched a tombstone (publisher couldn't serialize)
+        "fetch_errors",          # transport errors (depot unreachable)
+        "wait_timeouts",         # follower gave up waiting for the publish
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {k: 0 for k in self.FIELDS}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: v for k, v in self._c.items() if v}
+
+
+# --------------------------------------------------------- fingerprint --
+
+def toolchain_versions() -> dict:
+    """The version tuple baked into every fingerprint AND stored inside
+    each entry: the fingerprint makes skewed toolchains miss, the stored
+    copy catches the subtler case of a key scheme change across releases
+    (validated on fetch -> counted fingerprint_mismatch, cold compile)."""
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+def fingerprint(hlo_text: str, mesh=None, platform: str = "",
+                extra: tuple = ()) -> str:
+    """Content-address a compiled program: sha256 over the lowered HLO,
+    the mesh/topology it was built for, and the toolchain that built it.
+    Everything that changes the machine code must be in here — two
+    workers computing the same key MUST be able to share the executable.
+    """
+    h = hashlib.sha256()
+    h.update(hlo_text.encode())
+    if mesh is not None:
+        h.update(json.dumps(sorted(dict(mesh.shape).items())).encode())
+        kinds = sorted({getattr(d, "device_kind", "?")
+                        for d in mesh.devices.flat})
+        h.update(json.dumps([kinds, int(mesh.devices.size)]).encode())
+    if not platform:
+        import jax
+
+        platform = jax.default_backend()
+    h.update(platform.encode())
+    h.update(json.dumps(toolchain_versions(), sort_keys=True).encode())
+    for x in extra:
+        h.update(str(x).encode())
+    return h.hexdigest()
+
+
+# -------------------------------------------------------- entry format --
+
+def pack_entry(key: str, payload, error: str = "") -> bytes:
+    """One self-describing blob per executable. ``payload`` is the
+    3-tuple from ``serialize_executable.serialize``; ``error`` instead of
+    a payload publishes a TOMBSTONE — "the compile happened but this
+    platform cannot serialize it" — so waiting followers stop waiting and
+    compile locally instead of burning the full wait window."""
+    return pickle.dumps({
+        "format": _FORMAT,
+        "fingerprint": key,
+        "versions": toolchain_versions(),
+        "error": error,
+        "payload": payload,
+    })
+
+
+def unpack_entry(data: bytes, key: str) -> dict:
+    """Validate + unpack; raises FingerprintMismatch for an entry built by
+    a skewed toolchain or keyed under the wrong program, and any other
+    exception for plain corruption (both are counted cold fallbacks)."""
+    entry = pickle.loads(data)
+    if entry.get("format") != _FORMAT:
+        raise FingerprintMismatch(f"entry format {entry.get('format')!r}")
+    if entry.get("fingerprint") != key:
+        raise FingerprintMismatch(
+            f"entry fingerprint {entry.get('fingerprint')!r} != {key!r}")
+    if entry.get("versions") != toolchain_versions():
+        raise FingerprintMismatch(
+            f"entry built by {entry.get('versions')}, "
+            f"this worker runs {toolchain_versions()}")
+    return entry
+
+
+# ----------------------------------------------------------- backends --
+
+def _safe_key(key: str) -> str:
+    if not key or not all(c in "0123456789abcdef" for c in key):
+        raise ValueError(f"bad depot key {key!r}")
+    return key
+
+
+class DirectoryDepot:
+    """Shared-directory transport (local backend / mounted bucket).
+
+    ``put`` is atomic and first-wins: the entry is written to a temp file
+    and ``os.link``ed into place, which fails if the name exists — the
+    concurrent first-compile race has exactly one publisher by
+    construction, no locking needed."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self.path, _safe_key(key) + _ENTRY_SUFFIX)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._p(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def put(self, key: str, data: bytes, replace: bool = False) -> bool:
+        """``replace=True`` atomically overwrites — used ONLY by a worker
+        that fetched the existing entry and found it bad (corrupt,
+        tombstoned, toolchain-skewed): without it one transient serialize
+        failure would pin a tombstone under the key forever and disable
+        compile-once for that program."""
+        dst = self._p(key)
+        tmp = f"{dst}.{os.getpid()}.{threading.get_ident()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        if replace:
+            os.replace(tmp, dst)        # atomic heal; last writer wins
+            return True
+        try:
+            os.link(tmp, dst)           # atomic claim: EEXIST = lost race
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def keys(self) -> list[str]:
+        """Most-recent-first, so a bounded pre-fetch grabs what the next
+        job is most likely to run."""
+        try:
+            names = [n for n in os.listdir(self.path)
+                     if n.endswith(_ENTRY_SUFFIX)]
+        except OSError:
+            return []
+        names.sort(key=lambda n: -os.path.getmtime(
+            os.path.join(self.path, n)))
+        return [n[:-len(_ENTRY_SUFFIX)] for n in names]
+
+
+class HTTPDepot:
+    """Operator-served transport (kube backend): GET/POST
+    ``{url}/{key}`` over the same daemon that sinks heartbeats."""
+
+    def __init__(self, url: str, token: str = "", timeout_s: float = 10.0):
+        self.url = url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+
+    def _req(self, method: str, path: str, data: Optional[bytes] = None,
+             replace: bool = False):
+        headers = {DEPOT_TOKEN_HEADER: self.token,
+                   "Content-Type": "application/octet-stream"}
+        if replace:
+            headers[DEPOT_REPLACE_HEADER] = "1"
+        req = urllib.request.Request(
+            f"{self.url}{path}", method=method, data=data, headers=headers)
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with self._req("GET", f"/{_safe_key(key)}") as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        # connection errors propagate: the caller counts fetch_errors
+
+    def put(self, key: str, data: bytes, replace: bool = False) -> bool:
+        with self._req("POST", f"/{_safe_key(key)}", data,
+                       replace=replace) as resp:
+            doc = json.loads(resp.read().decode() or "{}")
+        return bool(doc.get("published"))
+
+    def keys(self) -> list[str]:
+        try:
+            with self._req("GET", "") as resp:
+                return list(json.loads(resp.read().decode()).get("keys", []))
+        except (urllib.error.URLError, OSError, ValueError):
+            return []
+
+
+class LocalCacheDepot:
+    """A remote depot fronted by a node-local directory: reads consult the
+    cache first (the warm pool's claim-time pre-fetch lands entries here),
+    remote reads write through, publishes go to both."""
+
+    def __init__(self, remote, cache_dir: str, stats: Optional[DepotStats] = None):
+        self.remote = remote
+        self.cache = DirectoryDepot(cache_dir)
+        self.stats = stats
+
+    def get(self, key: str) -> Optional[bytes]:
+        data = self.cache.get(key)
+        if data is not None:
+            if self.stats is not None:
+                self.stats.inc("cache_hits")
+            return data
+        data = self.remote.get(key)
+        if data is not None:
+            self.cache.put(key, data)
+        return data
+
+    def put(self, key: str, data: bytes, replace: bool = False) -> bool:
+        self.cache.put(key, data, replace=True)   # own disk: always heal
+        return self.remote.put(key, data, replace=replace)
+
+    def keys(self) -> list[str]:
+        return self.remote.keys()
+
+
+def depot_from_env(env: Optional[dict] = None,
+                   stats: Optional[DepotStats] = None):
+    """The worker-side env contract: KFT_DEPOT (dir path or http(s) URL,
+    operator-injected like KFT_HEARTBEAT_FILE), KFT_DEPOT_TOKEN (HTTP
+    fence), KFT_DEPOT_CACHE (pod-local cache dir, pre-fetch target).
+    Returns None when no depot is configured."""
+    env = env if env is not None else os.environ
+    target = env.get("KFT_DEPOT")
+    if not target:
+        return None
+    if target.startswith(("http://", "https://")):
+        remote = HTTPDepot(target, token=env.get("KFT_DEPOT_TOKEN", ""))
+    else:
+        remote = DirectoryDepot(target)
+    cache = env.get("KFT_DEPOT_CACHE")
+    return LocalCacheDepot(remote, cache, stats) if cache else remote
+
+
+# ------------------------------------------------------ load or compile --
+
+def _fetch(depot, key: str,
+           stats: DepotStats) -> tuple[Optional[bytes], bool]:
+    """-> (data, transport_error). A clean miss (None, False) and a dead
+    transport (None, True) must stay distinguishable: a follower may keep
+    WAITING through misses — the publish is coming — but must not burn
+    its whole wait window polling a depot that errors every time."""
+    try:
+        return depot.get(key), False
+    except Exception:
+        stats.inc("fetch_errors")
+        return None, True
+
+
+def load_or_compile(lowered, depot=None, *, mesh=None, extra: tuple = (),
+                    stats: Optional[DepotStats] = None,
+                    wait_s: float = 0.0, poll_s: float = 0.5):
+    """The one entry point: fingerprint ``lowered``, fetch the executable
+    from the depot or compile-and-publish it. Returns ``(compiled,
+    outcome)`` where outcome is "hit" / "published" / "compiled" /
+    "no_depot". NEVER raises on depot trouble — every degraded path is a
+    counted local compile (see module docstring, fallback semantics).
+
+    ``wait_s > 0`` is the FOLLOWER mode (gang process_id > 0): poll for
+    the coordinator's publish instead of racing it with an Nth identical
+    compile; a tombstone entry (publisher couldn't serialize) or the
+    timeout ends the wait and compiles locally, counted.
+    """
+    stats = stats if stats is not None else DepotStats()
+    if depot is None:
+        return lowered.compile(), "no_depot"
+    key = fingerprint(lowered.as_text(), mesh=mesh, extra=extra)
+
+    deadline = time.monotonic() + max(0.0, wait_s)
+    waited = False
+    bad_entry = False     # fetched an entry, proved it unusable: the
+    #                       local compile may REPLACE it (heal the key)
+    while True:
+        data, transport_error = _fetch(depot, key, stats)
+        if transport_error:
+            # dead/unreachable/token-skewed depot: waiting cannot help —
+            # fail open to the local compile NOW, not at the deadline
+            break
+        if data is not None:
+            entry = None
+            try:
+                entry = unpack_entry(data, key)
+            except FingerprintMismatch:
+                stats.inc("fingerprint_mismatches")
+                bad_entry = True
+            except Exception:
+                stats.inc("deserialize_failures")
+                bad_entry = True
+            if entry is not None:
+                if entry.get("error"):
+                    # tombstone: the publisher compiled but could not
+                    # serialize on this platform — nothing to wait for
+                    stats.inc("error_entries")
+                    bad_entry = True
+                    break
+                try:
+                    from jax.experimental import serialize_executable
+
+                    compiled = serialize_executable.deserialize_and_load(
+                        *entry["payload"])
+                    stats.inc("hits")
+                    return compiled, "hit"
+                except Exception:
+                    # the observed `DeserializeLoadedExecutable not
+                    # implemented` lands here: counted, then cold. The
+                    # key is platform-scoped, so an entry THIS runtime
+                    # cannot load is unusable for every key-sharer —
+                    # replaceable if our own serialize fares better
+                    stats.inc("deserialize_failures")
+                    bad_entry = True
+            break
+        if time.monotonic() >= deadline:
+            if waited:
+                stats.inc("wait_timeouts")
+            stats.inc("misses")
+            break
+        waited = True
+        time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))
+
+    compiled = lowered.compile()
+    stats.inc("compiles")
+    try:
+        from jax.experimental import serialize_executable
+
+        blob = pack_entry(key, serialize_executable.serialize(compiled))
+    except Exception as e:
+        stats.inc("serialize_failures")
+        try:
+            # never replace: a GOOD entry must not be tombstoned over
+            # because one worker failed to serialize
+            depot.put(key, pack_entry(key, None, error=str(e)))
+        except Exception:
+            stats.inc("fetch_errors")
+        return compiled, "compiled"
+    try:
+        published = depot.put(key, blob, replace=bad_entry)
+    except Exception:
+        stats.inc("fetch_errors")
+        return compiled, "compiled"
+    if published:
+        stats.inc("publishes")
+        return compiled, "published"
+    stats.inc("publish_races")
+    return compiled, "compiled"

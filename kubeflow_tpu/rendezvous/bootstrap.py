@@ -14,6 +14,14 @@ import os
 import time
 from typing import Optional
 
+# executable-depot env contract (KFT_DEPOT / KFT_DEPOT_TOKEN /
+# KFT_DEPOT_CACHE): re-exported here because this module IS the
+# worker-side env contract — workers resolve their depot next to the
+# compile cache below. The depot goes further than the cache: it ships
+# the COMPILED executable across nodes (compile-once at gang width N),
+# where jax_compilation_cache_dir only helps processes sharing a disk.
+from kubeflow_tpu.parallel.depot import depot_from_env  # noqa: F401
+
 
 @dataclasses.dataclass
 class WorldInfo:

@@ -13,10 +13,10 @@ import os
 import sys
 
 
-def _phase(phases: dict, name: str) -> None:
+def _phase(phases: dict, name: str, extra: dict | None = None) -> None:
     """Record a named absolute timestamp; flushed to KFT_PHASES_PATH so the
     operator/bench can decompose submit->first-step into pod spawn /
-    imports / rendezvous / compile+step (BASELINE.md row 2).
+    imports / rendezvous / compile / step 1 (BASELINE.md row 2).
 
     Two transports behind the one env value, mirroring KFT_HEARTBEAT_FILE:
     a filesystem path (shared-fs backends) writes an atomic JSON file; an
@@ -24,7 +24,11 @@ def _phase(phases: dict, name: str) -> None:
     POSTs {"phases": {...}} to the operator, which folds it into
     ``Operator.phase_reports``. Whole-dict posts each time: delivery is
     at-least-once and the receiver merges, so a lost or reordered POST
-    costs one stamp's latency, never the decomposition."""
+    costs one stamp's latency, never the decomposition.
+
+    ``extra`` rides the same POST body (e.g. {"depot": counters} — the
+    operator folds it into kft_depot_* metrics); on the file transport
+    each extra key lands in its own ``{path}.{key}.{process}`` file."""
     import time
 
     phases[name] = time.time()
@@ -33,13 +37,15 @@ def _phase(phases: dict, name: str) -> None:
         return
     import json
 
+    proc = os.environ.get("KFT_PROCESS_ID", "0")
     if path.startswith(("http://", "https://")):
         import urllib.request
 
         try:
             req = urllib.request.Request(
                 path, method="POST",
-                data=json.dumps({"phases": phases}).encode(),
+                data=json.dumps(
+                    {"phases": phases, **(extra or {})}).encode(),
                 headers={"Content-Type": "application/json"})
             urllib.request.urlopen(req, timeout=5).close()
         except Exception:
@@ -48,8 +54,12 @@ def _phase(phases: dict, name: str) -> None:
     try:
         with open(f"{path}.{os.getpid()}", "w") as f:
             json.dump(phases, f)
-        os.replace(f"{path}.{os.getpid()}",
-                   f"{path}.{os.environ.get('KFT_PROCESS_ID', '0')}")
+        os.replace(f"{path}.{os.getpid()}", f"{path}.{proc}")
+        for key, val in (extra or {}).items():
+            with open(f"{path}.{key}.{os.getpid()}", "w") as f:
+                json.dump(val, f)
+            os.replace(f"{path}.{key}.{os.getpid()}",
+                       f"{path}.{key}.{proc}")
     except OSError:
         pass
 
@@ -122,6 +132,40 @@ def main() -> int:
             return (put_batch(mesh, b) for b in synthetic_lm_batches(
                 cfg.vocab_size, global_batch, 16, start_step=start))
 
+        # compile split from step 1 (the executable-depot fast path):
+        # fetch the gang's train-step executable from the depot — or
+        # compile and publish it — BEFORE fit, and stamp compile_done so
+        # the submit→first-step decomposition separates compile from the
+        # first real step. Followers (process_id > 0) wait for the
+        # coordinator's publish instead of racing it with an identical
+        # compile; every depot fallback is a counted local compile.
+        from kubeflow_tpu.parallel.depot import DepotStats
+        from kubeflow_tpu.rendezvous.bootstrap import depot_from_env
+
+        dstats = DepotStats()
+        try:
+            depot = depot_from_env(stats=dstats)
+        except Exception:
+            # fail-open like every depot path: an unwritable KFT_DEPOT /
+            # KFT_DEPOT_CACHE dir (read-only mount, deleted path) must
+            # cost the fast path, never the job
+            dstats.inc("fetch_errors")
+            depot = None
+        wait_s = (float(os.environ.get("KFT_DEPOT_WAIT_S", "120"))
+                  if depot is not None and not world.is_coordinator
+                  else 0.0)
+        trainer.init_state(jax.random.key(0))
+        # state_init_done..compile_done isolates the train-step
+        # lower+compile (the depot-amortizable part) from the param/opt
+        # init compiles and jit setup that precede it — without this
+        # stamp a depot hit still looks compile-bound from outside
+        _phase(phases, "state_init_done")
+        depot_outcome = trainer.precompile(
+            next(batches(0)), depot=depot, stats=dstats, wait_s=wait_s)
+        _phase(phases, "compile_done",
+               extra={"depot": dstats.snapshot()} if depot is not None
+               else None)
+
         metrics = MetricsWriter(metrics_path) if metrics_path else None
 
         def _first_step(step, m):
@@ -133,7 +177,8 @@ def main() -> int:
                      checkpoint_dir=os.environ.get("KFT_CHECKPOINT_DIR"),
                      on_step=_first_step)
         print(f"worker {world.process_id}: trained to step "
-              f"{result.final_step} (resumed_from={result.resumed_from})")
+              f"{result.final_step} (resumed_from={result.resumed_from}, "
+              f"depot={depot_outcome})")
 
     print(f"worker {world.process_id}/{world.num_processes}: world ok, "
           f"devices={n_global}, collective={total}")

@@ -169,7 +169,12 @@ def fit(
     if heartbeat is None and os.environ.get("KFT_HEARTBEAT_FILE"):
         heartbeat = Heartbeat(os.environ["KFT_HEARTBEAT_FILE"])
 
-    trainer.init_state(rng)
+    # a caller that already initialized (e.g. worker_check's precompile
+    # phase, which needs live state to lower the step) keeps its state —
+    # re-running init here would both waste a full param/opt init and
+    # land it inside the phase the bench attributes to step 1
+    if trainer.params is None:
+        trainer.init_state(rng)
     resumed_from = None
     mgr = None
     if checkpoint_dir:

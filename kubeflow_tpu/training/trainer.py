@@ -115,6 +115,10 @@ class Trainer:
         )
 
         self.step_fn = self._build_step(donate_state)
+        # AOT-compiled step installed by precompile(): same program, but
+        # the compile happened eagerly (and possibly on another worker —
+        # the executable-depot fast path) instead of inside step 1
+        self._compiled_step = None
         self.params = None
         self.opt_state = None
         self.step = 0
@@ -225,12 +229,35 @@ class Trainer:
         # PartitionSpecs against the ambient mesh and silently no-op
         # without one — which costs activation sharding (batch stays
         # data-sharded only, fsdp/tensor axes unused) on multichip
+        fn = self._compiled_step if self._compiled_step is not None \
+            else self.step_fn
         with self.mesh:
-            self.params, self.opt_state, metrics = self.step_fn(
+            self.params, self.opt_state, metrics = fn(
                 self.params, self.opt_state, batch
             )
         self.step += 1
         return metrics
+
+    def precompile(self, batch, depot=None, stats=None,
+                   wait_s: float = 0.0) -> str:
+        """Split compile from step 1: lower the train step for ``batch``'s
+        shapes and compile it NOW — fetching the executable from an
+        executable depot (``parallel/depot.py``) when one is given, and
+        publishing it on a miss so the rest of the gang (and every
+        warm-pool resubmit) deserializes instead of compiling. Requires
+        ``init_state`` first; pins the batch shape subsequent
+        ``train_step`` calls use. Returns the depot outcome ("hit" /
+        "published" / "compiled" / "no_depot"); depot trouble NEVER
+        raises — worst case is the compile this call was going to pay
+        anyway."""
+        if self.params is None:
+            raise ValueError("precompile needs init_state() first")
+        from kubeflow_tpu.parallel.depot import load_or_compile
+
+        lowered = self.lower_step(self.params, self.opt_state, batch)
+        self._compiled_step, outcome = load_or_compile(
+            lowered, depot, mesh=self.mesh, stats=stats, wait_s=wait_s)
+        return outcome
 
     def lower_step(self, params_shapes, opt_shapes, batch_shapes):
         """AOT entry (parallel/aot.py scale proofs): lower the train step

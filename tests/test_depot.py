@@ -1,0 +1,312 @@
+"""Executable depot (parallel/depot.py): the compile-once fast path and —
+more importantly — every way it must FAIL OPEN. A depot problem is never a
+job failure: fingerprint skew, corrupt blobs, lost publish races and dead
+transports all degrade to a counted local compile, and the counters reach
+operator /metrics so a silently-dead depot regresses visibly."""
+
+import json
+import pickle
+import shutil
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.parallel.depot import (
+    DEPOT_TOKEN_HEADER, DepotStats, DirectoryDepot, HTTPDepot,
+    depot_from_env, fingerprint, load_or_compile, pack_entry,
+)
+
+
+def _lowered(c: float = 1.0):
+    """A tiny donating, pytree-shaped program — the trainer step's shape
+    without its compile time."""
+    def step(state, batch):
+        return {"w": state["w"] + batch.sum() * c}, {"loss": batch.mean()}
+
+    return jax.jit(step, donate_argnums=(0,)).lower(
+        {"w": jnp.ones((4,))}, jnp.ones((2, 2)))
+
+
+def _run(compiled):
+    out, m = compiled({"w": jnp.ones((4,))}, jnp.ones((2, 2)))
+    return float(out["w"][0]), float(m["loss"])
+
+
+# ------------------------------------------------------------ fast path --
+
+def test_publish_then_hit_roundtrip(tmp_path):
+    depot = DirectoryDepot(str(tmp_path))
+    s1 = DepotStats()
+    c1, outcome1 = load_or_compile(_lowered(), depot, stats=s1)
+    assert outcome1 == "published"
+    assert s1.snapshot() == {"misses": 1, "compiles": 1, "publishes": 1}
+
+    s2 = DepotStats()
+    c2, outcome2 = load_or_compile(_lowered(), depot, stats=s2)
+    assert outcome2 == "hit"
+    assert s2.snapshot() == {"hits": 1}
+    assert _run(c1) == _run(c2)
+
+
+def test_fingerprint_varies_with_program_and_extra():
+    a = fingerprint(_lowered(1.0).as_text())
+    b = fingerprint(_lowered(2.0).as_text())
+    c = fingerprint(_lowered(1.0).as_text(), extra=("v2",))
+    assert a != b and a != c
+
+
+# ------------------------------------------------- counted cold fallbacks --
+
+def test_fingerprint_mismatch_is_counted_cold_fallback(tmp_path):
+    """A version-skewed publisher: the entry sits under the right key but
+    its recorded toolchain differs (what a jax upgrade produces if the
+    key scheme ever misses an input) -> counted mismatch, local compile,
+    job proceeds."""
+    depot = DirectoryDepot(str(tmp_path))
+    lo = _lowered()
+    key = fingerprint(lo.as_text())
+    skewed = pickle.loads(pack_entry(key, None))
+    skewed["versions"] = {"jax": "0.0.1", "jaxlib": "0.0.1"}
+    assert depot.put(key, pickle.dumps(skewed))
+
+    stats = DepotStats()
+    compiled, outcome = load_or_compile(lo, depot, stats=stats)
+    assert stats.get("fingerprint_mismatches") == 1
+    assert stats.get("deserialize_failures") == 0
+    assert _run(compiled)[1] == 1.0
+    # the proven-bad entry was REPLACED (healed), not pinned forever
+    assert outcome == "published"
+    s2 = DepotStats()
+    _, outcome2 = load_or_compile(_lowered(), depot, stats=s2)
+    assert outcome2 == "hit"
+
+
+def test_corrupt_entry_is_counted_cold_fallback(tmp_path):
+    depot = DirectoryDepot(str(tmp_path))
+    lo = _lowered()
+    key = fingerprint(lo.as_text())
+    assert depot.put(key, b"\x80\x04 definitely not an executable")
+
+    stats = DepotStats()
+    compiled, outcome = load_or_compile(lo, depot, stats=stats)
+    assert stats.get("deserialize_failures") == 1
+    assert _run(compiled)[1] == 1.0
+    assert outcome == "published"        # corrupt blob healed in place
+    assert load_or_compile(_lowered(), depot,
+                           stats=DepotStats())[1] == "hit"
+
+
+def test_unreachable_depot_is_counted_cold_fallback():
+    depot = HTTPDepot("http://127.0.0.1:9", timeout_s=0.2)   # discard port
+    stats = DepotStats()
+    compiled, outcome = load_or_compile(_lowered(), depot, stats=stats)
+    assert outcome == "compiled"
+    assert stats.get("fetch_errors") >= 1
+    assert _run(compiled)[1] == 1.0
+
+
+def test_dead_transport_ends_follower_wait_immediately():
+    """A follower must not burn its whole wait window polling a depot
+    that errors on every fetch — a transport error (vs a clean miss)
+    fails open to the local compile NOW."""
+    import time
+
+    depot = HTTPDepot("http://127.0.0.1:9", timeout_s=0.2)
+    stats = DepotStats()
+    t0 = time.monotonic()
+    compiled, outcome = load_or_compile(_lowered(), depot, stats=stats,
+                                        wait_s=60, poll_s=0.05)
+    assert time.monotonic() - t0 < 30          # nowhere near the window
+    assert outcome == "compiled"
+    assert stats.get("fetch_errors") >= 1      # fetch + failed publish
+    assert stats.get("wait_timeouts") == 0
+    assert _run(compiled)[1] == 1.0
+
+
+# ----------------------------------------------------- one-publisher race --
+
+def test_concurrent_first_compile_has_exactly_one_publisher(tmp_path):
+    depot = DirectoryDepot(str(tmp_path))
+    outcomes = []
+    barrier = threading.Barrier(4)
+
+    def racer():
+        lo = _lowered()
+        barrier.wait()
+        _, outcome = load_or_compile(lo, depot, stats=DepotStats())
+        outcomes.append(outcome)
+
+    threads = [threading.Thread(target=racer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(outcomes) == 4
+    # racers that found the winner's entry already up count as hits;
+    # racers that compiled concurrently lose the publish -> "compiled"
+    assert outcomes.count("published") == 1, outcomes
+    assert len(depot.keys()) == 1
+
+
+def test_follower_waits_for_coordinator_publish(tmp_path):
+    """Gang semantics: process_id > 0 polls for the coordinator's entry
+    instead of racing it with an Nth identical compile."""
+    depot = DirectoryDepot(str(tmp_path))
+    result = {}
+
+    def follower():
+        s = DepotStats()
+        _, outcome = load_or_compile(_lowered(), depot, stats=s,
+                                     wait_s=30, poll_s=0.05)
+        result["outcome"], result["stats"] = outcome, s.snapshot()
+
+    t = threading.Thread(target=follower)
+    t.start()
+    _, coord = load_or_compile(_lowered(), depot, stats=DepotStats())
+    t.join(timeout=60)
+    assert coord == "published"
+    assert result["outcome"] == "hit", result
+
+
+def test_serialize_failure_publishes_tombstone_follower_compiles(tmp_path):
+    """A publisher whose platform cannot serialize must leave a tombstone
+    so followers stop waiting immediately instead of burning the window."""
+    depot = DirectoryDepot(str(tmp_path))
+    lo = _lowered()
+    key = fingerprint(lo.as_text())
+    depot.put(key, pack_entry(
+        key, None, error="DeserializeLoadedExecutable not implemented"))
+
+    stats = DepotStats()
+    compiled, outcome = load_or_compile(lo, depot, stats=stats,
+                                        wait_s=30, poll_s=0.05)
+    assert stats.get("error_entries") == 1
+    assert stats.get("wait_timeouts") == 0      # ended by the tombstone
+    assert _run(compiled)[1] == 1.0
+    # this platform CAN serialize, so the tombstone is healed with the
+    # real executable instead of poisoning the key forever
+    assert outcome == "published"
+    assert load_or_compile(_lowered(), depot,
+                           stats=DepotStats())[1] == "hit"
+
+
+# ------------------------------------------------- warm-pool pre-fetch --
+
+def test_warm_pool_claim_prefetch_hit(tmp_path):
+    """Claim-time pre-fetch: the pool syncs depot entries into the
+    claimed pod's local cache; the worker then hits WITHOUT touching the
+    remote (proven by deleting it)."""
+    from kubeflow_tpu.controller.warmpool import WarmPoolController
+
+    remote_dir, cache_dir = str(tmp_path / "remote"), str(tmp_path / "c")
+    remote = DirectoryDepot(remote_dir)
+    _, outcome = load_or_compile(_lowered(), remote, stats=DepotStats())
+    assert outcome == "published"
+
+    pool = WarmPoolController(object())
+    env = {"KFT_DEPOT": remote_dir, "KFT_DEPOT_CACHE": cache_dir}
+    pool._prefetch_depot(env)
+    assert pool.prefetched_entries == 1 and pool.prefetch_errors == 0
+    pool._prefetch_depot(env)            # idempotent: already cached
+    assert pool.prefetched_entries == 1
+
+    shutil.rmtree(remote_dir)
+    stats = DepotStats()
+    depot = depot_from_env(env, stats=stats)
+    compiled, outcome = load_or_compile(_lowered(), depot, stats=stats)
+    assert outcome == "hit"
+    assert stats.get("cache_hits") == 1
+    assert _run(compiled)[1] == 1.0
+
+
+# -------------------------------------------- operator transport + metrics --
+
+@pytest.fixture()
+def operator(tmp_path):
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+
+    op = Operator(JobController(FakeCluster()),
+                  heartbeat_dir=str(tmp_path / "hb"))
+    op.start(port=0)
+    yield op
+    op.stop()
+
+
+def test_operator_depot_http_routes(operator):
+    url = f"{operator.advertise_url}/apis/v1/depot"
+    depot = HTTPDepot(url, token=operator.depot_token)
+    lo = _lowered()
+    key = fingerprint(lo.as_text())
+
+    assert depot.get(key) is None                    # miss, counted
+    assert operator.metrics.get("kft_depot_server_misses_total") == 1
+    blob = pack_entry(key, None, error="placeholder")
+    assert depot.put(key, blob) is True
+    assert depot.put(key, blob) is False             # first-wins
+    assert operator.metrics.get("kft_depot_publishes_total") == 1
+    assert operator.metrics.get("kft_depot_publish_races_total") == 1
+    assert depot.get(key) == blob
+    blob2 = pack_entry(key, None, error="healed")
+    assert depot.put(key, blob2, replace=True) is True   # explicit heal
+    assert depot.get(key) == blob2
+    assert operator.metrics.get("kft_depot_server_hits_total") == 2
+    assert depot.keys() == [key]
+
+    # the fence: no/wrong token is refused (a depot entry is code)
+    naked = HTTPDepot(url, token="wrong")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        naked.get(key)
+    assert e.value.code == 403
+    req = urllib.request.Request(f"{url}/{key}", method="POST", data=b"x")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 403
+
+
+def test_worker_depot_counters_reach_metrics_without_job_failure(operator):
+    """The acceptance contract: a deserialize failure is a counted
+    /metrics fallback delivered over the phases transport — and the
+    at-least-once re-post must not double count."""
+    from kubeflow_tpu.api.types import jax_job
+
+    operator.submit(jax_job("dj", workers=1, mesh={"data": 1}))
+    job = operator.controller.get("default", "dj")
+    body = {"phases": {"compile_done": 12.0},
+            "depot": {"deserialize_failures": 2, "hits": 1}}
+    assert operator.heartbeat_post("default", "dj", "p0", body,
+                                   uid=job.uid)
+    assert operator.metrics.get(
+        "kft_depot_worker_deserialize_failures_total") == 2
+    assert operator.metrics.get("kft_depot_worker_hits_total") == 1
+    assert operator.heartbeat_post("default", "dj", "p0", body,
+                                   uid=job.uid)     # re-post: no change
+    assert operator.metrics.get(
+        "kft_depot_worker_deserialize_failures_total") == 2
+    # restarted pod (same name+uid, counters reset): Prometheus
+    # counter-reset semantics — the fresh counts are NOT swallowed
+    # under the dead incarnation's high-water mark
+    operator.heartbeat_post("default", "dj", "p0",
+                            {"depot": {"deserialize_failures": 1}},
+                            uid=job.uid)
+    assert operator.metrics.get(
+        "kft_depot_worker_deserialize_failures_total") == 3
+    # rendered for a real scraper, job untouched
+    text = operator.metrics.render()
+    assert "kft_depot_worker_deserialize_failures_total 3" in text
+    assert not operator.controller.get("default", "dj").status.is_finished()
+
+
+def test_operator_injects_depot_env_on_shared_fs(operator):
+    """The pod mutator stamps the directory-depot contract next to the
+    heartbeat file (shared-fs backends)."""
+    from kubeflow_tpu.controller.cluster import Pod
+
+    pod = operator.controller.pod_mutator(Pod(
+        name="w0", namespace="default",
+        labels={"job-name": "j", "job-uid": "u1"}, env={}, command=[]))
+    assert pod.env["KFT_DEPOT"] == operator.depot.path
+    assert json.loads(json.dumps(pod.env))           # plain strings only
