@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -71,6 +71,29 @@ test-compile-depot: kube-bench
 		assert e['warm_pool'].get('prefetched_entries', 0) >= 1, d; \
 		print('compile-depot bench OK: depot=' + json.dumps(e['depot']) \
 			+ ' compile_ratio=' + str(e.get('depot_compile_ratio')))"
+
+# serving-scheduler e2e: the scheduler + radix-cache unit suites, then a
+# bounded 128-stream shared-system-prompt bench smoke. Two independent
+# teeth (like test-warmpool): bench.py exits nonzero unless every stream
+# completed, the radix cache REALLY hit, and the scheduler counters are
+# in the JSON; the JSON contract is then re-checked from the captured
+# file so a silently-dead cache or counter rename regresses visibly.
+SERVING_SMOKE_JSON := /tmp/kft-serving-smoke.json
+test-serving-sched:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scheduler.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --serving-smoke > $(SERVING_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(SERVING_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; s = e['sched']; \
+		assert e['prefix_hit_blocks'] > 0, ('no prefix hits', d); \
+		assert e['completed'] == e['streams'] == 128, d; \
+		assert e['e2e_vs_device_only'] is not None, d; \
+		assert s['decode_dispatches_total'] > 0, d; \
+		assert all(k in s for k in ('occupancy_ratio', 'queue_depth', \
+			'preempts_total', 'prefix_hit_rate', 'admission_stalls_total')), d; \
+		print('serving-sched bench OK: rps=' + str(e['requests_per_sec']) \
+			+ ' prefix_hit_rate=' + str(e['prefix_hit_rate']) \
+			+ ' e2e_vs_device_only=' + str(e['e2e_vs_device_only']))"
 
 native:
 	$(MAKE) -C native/metadata_store

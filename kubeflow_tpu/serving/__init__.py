@@ -14,7 +14,9 @@ from kubeflow_tpu.serving.protocol import (
     InferRequest, InferResponse, InferTensor,
 )
 from kubeflow_tpu.serving.agents import BatchingModel, LoggingModel, ModelPuller
+from kubeflow_tpu.serving.paged_kv import RadixPrefixCache
 from kubeflow_tpu.serving.router import GraphRouter, TrafficSplitter
+from kubeflow_tpu.serving.scheduler import SchedulerConfig, StepScheduler
 from kubeflow_tpu.serving.server import InferenceClient, ModelServer
 from kubeflow_tpu.serving.v2_socket import V2SocketClient, V2SocketServer
 from kubeflow_tpu.serving.storage import download
@@ -31,7 +33,8 @@ __all__ = [
     "InferTensor", "InferenceClient", "InferenceGraph", "InferenceService",
     "JAXModel", "LLMEngine", "LLMModel", "Model", "ModelFormat",
     "ModelMissing", "ModelNotReady", "ModelRepository", "ModelServer",
-    "PredictorSpec", "RuntimeRegistry", "SamplingParams", "ServingController",
-    "ServingRuntime", "TrafficSplitter", "TrainedModel", "V2SocketClient",
+    "PredictorSpec", "RadixPrefixCache", "RuntimeRegistry", "SamplingParams",
+    "SchedulerConfig", "ServingController", "ServingRuntime", "StepScheduler",
+    "TrafficSplitter", "TrainedModel", "V2SocketClient",
     "V2SocketServer", "download", "enable_compile_cache",
 ]

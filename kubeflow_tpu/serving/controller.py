@@ -242,6 +242,18 @@ class ServingController:
             "KFT_STORAGE_URI": isvc.predictor.storage_uri or "",
             "KFT_COMPILE_CACHE": runtime.compile_cache_dir or "",
         }
+        if isvc.predictor.scheduler is not None:
+            # step-scheduler knobs ride the same env contract the runtime
+            # entrypoint parses (serving/runtime.py)
+            sp = isvc.predictor.scheduler
+            predictor_env.update({
+                "KFT_PREFILL_QUOTA": str(sp.prefill_tokens_per_step),
+                "KFT_INTERLEAVE_PREFILL": "1" if sp.interleave_prefill
+                                          else "0",
+                "KFT_ADAPTIVE_DECODE_CHUNK":
+                    "1" if sp.adaptive_decode_chunk else "0",
+                "KFT_RADIX_CACHE": "1" if sp.radix_cache else "0",
+            })
         predictor_env.setdefault("KFT_MODEL_DIR", "/mnt/models")
         # storage-initializer injection (the reference does this in a pod
         # webhook; here the ISVC controller stamps the init step directly)

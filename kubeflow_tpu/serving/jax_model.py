@@ -174,11 +174,12 @@ class LLMModel(Model):
                  compile_cache_dir: Optional[str] = None,
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
                  tokenizer=None, request_timeout: float = 600.0,
-                 mesh=None):
+                 mesh=None, scheduler=None):
         super().__init__(name)
         self._params = params
         self.cfg = cfg
         self.mesh = mesh
+        self.scheduler = scheduler     # SchedulerConfig / SchedulerPolicy
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.pad_id = pad_id
@@ -221,7 +222,7 @@ class LLMModel(Model):
             max_seq=self.max_seq,
             prefill_buckets=[b for b in self.prefill_buckets
                              if b <= self.max_seq] or [self.max_seq],
-            mesh=self.mesh)
+            mesh=self.mesh, scheduler=self.scheduler)
         self._shutdown = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -278,7 +279,9 @@ class LLMModel(Model):
 
     def stats(self) -> dict:
         """Engine gauges for the /metrics scrape (KPA + capacity planning):
-        generated token count, decode steps, KV pool occupancy, prefix hits."""
+        generated token count, decode steps, KV pool occupancy, prefix
+        hits, plus the step scheduler's counter set (nested under "sched"
+        — the server flattens it to ``kft_model_sched_*``)."""
         eng = self.engine
         if eng is None:
             return {}
@@ -291,6 +294,7 @@ class LLMModel(Model):
             "kv_free_blocks": eng.paged.allocator.free_blocks,
             "kv_reclaimable_blocks": eng.paged.reclaimable_blocks,
             "prefix_cache_hits_total": eng.paged.prefix_hits,
+            "sched": eng.scheduler_stats(),
         }
 
     def predict(self, request: InferRequest) -> InferResponse:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.scheduler import SchedulerConfig, StepScheduler
 
 
 @dataclasses.dataclass
@@ -70,6 +71,22 @@ class GenRequest:
                 or self.generated[-1] in self.sampling.stop_token_ids):
             return "stop"
         return "length"
+
+
+@dataclasses.dataclass
+class _ChunkedPrefill:
+    """A long prompt streaming through chunked prefill across engine
+    steps (the scheduler interleaves one chunk per step with decode).
+    ``offset`` is the next position to prefill; positions < ``share_len``
+    are radix-shared (their chunks are skipped for compute and their
+    writes masked to scratch); ``tables`` is the device snapshot of the
+    block tables taken at reservation (this slot's row is immutable)."""
+
+    req: GenRequest
+    offset: int
+    share_len: int
+    tables: Any
+    x_last: Any = None
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -128,7 +145,8 @@ class LLMEngine:
                  decode_chunk: int = 8,
                  decode_pipeline: bool = True,
                  kernel: str = "auto",
-                 mesh=None):
+                 mesh=None,
+                 scheduler: Optional[SchedulerConfig] = None):
         from kubeflow_tpu.serving.paged_kv import (
             PagedKV, _lm_head as lm_head_fn, _resolve_decode_kernel,
             paged_prefill_chunk as paged_prefill_chunk_fn,
@@ -226,6 +244,18 @@ class LLMEngine:
         self.decode_pipeline = bool(decode_pipeline)
         self._inflight: Optional[dict] = None
         self._fresh = np.ones((max_batch,), bool)   # host token overrides
+        # step scheduler (serving/scheduler.py): per-step prefill token
+        # quota, interleaved chunked prefill, adaptive decode-chunk trims,
+        # and the counter set /metrics exports
+        self.sched = StepScheduler(scheduler, default_budget=self.buckets[-1],
+                                   decode_chunk=self.decode_chunk)
+        self.paged.prefix_cache = self.sched.cfg.radix_cache
+        # in-flight chunked prefills, slot -> state (insertion order = FIFO)
+        self._chunked: dict[int, _ChunkedPrefill] = {}
+        # chunk width is STATIC (one compile): the largest bucket, capped
+        # by the quota so one chunk always fits one step's budget
+        self._chunk_width = max(1, min(self.buckets[-1],
+                                       self.sched.prefill_budget()))
 
         self._prefill = jax.jit(
             lambda p, toks, lens, cache: llama.prefill(
@@ -234,9 +264,10 @@ class LLMEngine:
         # chunk size (the largest bucket) + traced offset/length keep the
         # compile count O(1) in prompt length
         self._prefill_chunk = jax.jit(
-            lambda p, toks, cache, tables, slot, offset, length:
+            lambda p, toks, cache, tables, slot, offset, length, share:
                 paged_prefill_chunk_fn(
-                    p, toks, self.cfg, cache, tables, slot, offset, length),
+                    p, toks, self.cfg, cache, tables, slot, offset, length,
+                    share),
             donate_argnums=(2,))
         # the lm head runs ONCE on the final chunk's hidden row, not per
         # chunk (full-vocab matmul is the expensive part of short chunks)
@@ -250,8 +281,9 @@ class LLMEngine:
                 (tok := sample_logits(logits, rng, t, k, p)),
                 jnp.take_along_axis(logits, tok[:, None], axis=-1)[:, 0]
                 - jax.nn.logsumexp(logits, axis=-1)))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(2,),
-                               static_argnames=("greedy_only", "kernel"))
+        self._decode = jax.jit(
+            self._decode_impl, donate_argnums=(2,),
+            static_argnames=("greedy_only", "kernel", "chunk_len"))
         self._merge_tok = jax.jit(
             lambda carry, upd, mask: jnp.where(mask, upd, carry))
         self._insert_batch = jax.jit(self._insert_batch_impl,
@@ -264,7 +296,8 @@ class LLMEngine:
     # ---------------- jitted bodies ----------------
 
     def _decode_impl(self, params, token, cache, tables, active, temperature,
-                     top_k, top_p, rng, greedy_only=False, kernel="gather"):
+                     top_k, top_p, rng, greedy_only=False, kernel="gather",
+                     chunk_len=1):
         from kubeflow_tpu.serving.paged_kv import paged_decode_step
 
         def one_step(carry, rng_step):
@@ -284,7 +317,7 @@ class LLMEngine:
             cache["len"] = jnp.where(active, cache["len"], 0)
             return (nxt, cache), (nxt, lp)
 
-        rngs = jax.random.split(rng, self.decode_chunk)
+        rngs = jax.random.split(rng, chunk_len)
         (next_tok, cache), (toks, lps) = jax.lax.scan(
             one_step, (token, cache), rngs)
         # next_tok: the device-side carry the pipelined dispatch feeds the
@@ -351,7 +384,19 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._waiting or self._active)
+            return bool(self._waiting or self._active or self._chunked)
+
+    def scheduler_stats(self) -> dict:
+        """Scheduler counters + gauges for /metrics (occupancy, queue
+        depth, prefix-hit and preempt counters — the serving controller's
+        autoscale/affinity signals, ROADMAP item 2)."""
+        with self._lock:
+            waiting = len(self._waiting)
+        return self.sched.snapshot(
+            active=len(self._active), waiting=waiting,
+            chunked=len(self._chunked), max_batch=self.max_batch,
+            prefix_hits=self.paged.prefix_hits,
+            prefix_queries=self.paged.prefix_queries)
 
     def step(self) -> list[GenRequest]:
         """Admit waiting requests, dispatch one decode chunk, retire
@@ -359,6 +404,7 @@ class LLMEngine:
         previous chunk's tokens are fetched, so device compute overlaps
         host transfer + bookkeeping; results therefore lag one chunk.
         Returns requests that finished this step."""
+        self.sched.note_step()
         with self._lock:
             aborted, self._aborted = self._aborted, set()
         if aborted:
@@ -367,6 +413,13 @@ class LLMEngine:
                     del self._active[slot]
                     self.paged.release(slot)
                     self._free.append(slot)
+            # abort of a request whose chunked prefill is mid-flight is
+            # observed HERE — between chunks — not after the full prompt:
+            # the slot and its private blocks come back immediately (the
+            # blocks it already published stay cached and shareable)
+            for slot, st in list(self._chunked.items()):
+                if st.req.id in aborted:
+                    self._cancel_chunked(slot)
         self._admit()
         new_inflight = None
         if self._active and self._need_dispatch():
@@ -388,18 +441,31 @@ class LLMEngine:
                     self._inflight["next"], jnp.asarray(self._tokens),
                     jnp.asarray(self._fresh))
             self._fresh[:] = False
+            tab = self.paged.tables
+            if self._chunked:
+                # mid-prefill slots are NOT decode-active, but their table
+                # rows are live: zero them for this dispatch so the idle
+                # scatter (len pinned 0) lands in the scratch block, never
+                # in a half-prefilled prompt block
+                tab = tab.copy()
+                for s in self._chunked:
+                    tab[s] = 0
+            chunk_len = self.sched.decode_chunk_len(
+                self._min_deterministic_remaining(),
+                pressure=bool(self._waiting))
+            self.sched.note_decode_dispatch(chunk_len)
             self._rng, step_rng = jax.random.split(self._rng)
             toks, lps, next_tok, self.cache = self._decode(
-                self.params, token_in, self.cache,
-                jnp.asarray(self.paged.tables),
+                self.params, token_in, self.cache, jnp.asarray(tab),
                 jnp.asarray(active_mask), jnp.asarray(temp),
                 jnp.asarray(top_k), jnp.asarray(top_p), step_rng,
                 # static: an all-greedy batch skips the per-step
                 # full-vocab sort (two compile variants total)
                 greedy_only=not bool((temp > 0).any()),
-                kernel=self.kernel)
+                kernel=self.kernel, chunk_len=chunk_len)
             new_inflight = {
                 "toks": toks, "lps": lps, "next": next_tok,
+                "chunk_len": chunk_len,
                 # snapshot: tokens belong to the requests active at
                 # DISPATCH time — a slot may host a new request by the
                 # time these arrays are read back
@@ -420,7 +486,7 @@ class LLMEngine:
         if self._inflight is None:
             return True
         snapshot_reqs = {id(r) for _, r in self._inflight["snapshot"]}
-        chunk = self.decode_chunk
+        chunk = self._inflight["chunk_len"]
         for _, req in self._active.items():
             if id(req) not in snapshot_reqs:
                 return True            # admitted after the dispatch
@@ -429,6 +495,28 @@ class LLMEngine:
                     < self.max_seq):
                 return True            # still needs tokens past the chunk
         return False
+
+    def _min_deterministic_remaining(self) -> Optional[int]:
+        """Earliest DETERMINISTIC finish (max_tokens / max_seq bound)
+        among active requests, net of tokens the in-flight chunk will
+        already have produced — the boundary the adaptive decode chunk
+        trims to so a freeing slot rejoins mid-chunk, not decode_chunk
+        device steps later. EOS finishes are not predictable and don't
+        count."""
+        snapshot_reqs = (
+            {id(r) for _, r in self._inflight["snapshot"]}
+            if self._inflight is not None else set())
+        pending = (self._inflight["chunk_len"]
+                   if self._inflight is not None else 0)
+        rem = None
+        for _, req in self._active.items():
+            r = min(req.sampling.max_tokens - len(req.generated),
+                    self.max_seq - len(req.prompt) - len(req.generated))
+            if id(req) in snapshot_reqs:
+                r -= pending
+            r = max(1, r)
+            rem = r if rem is None else min(rem, r)
+        return rem
 
     def _process_chunk(self, inflight: dict) -> list[GenRequest]:
         toks = np.asarray(inflight["toks"])     # [chunk, B] (blocks here)
@@ -472,29 +560,101 @@ class LLMEngine:
 
     # ---------------- internals ----------------
 
-    def _admit_chunked(self, req, slot: int):
-        """Stream a long prompt through the pool in fixed-size chunks
-        (chunked prefill). Returns the final chunk's logits (read at the
-        prompt's true last row). The slot's cache len stays 0 until the
-        caller publishes it, so partial writes are invisible to decode."""
-        chunk = self.buckets[-1]
+    def _start_chunked(self, req, slot: int, n_shared: int) -> None:
+        """Begin streaming a long prompt through chunked prefill. Chunks
+        whose every row is radix-shared are skipped outright (the shared
+        KV is already resident) — a fully-cached long prompt costs ONE
+        chunk (the final one, for its last-row logits)."""
         L = len(req.prompt)
-        x_last = None
-        tables = jnp.asarray(self.paged.tables)
-        for c0 in range(0, L, chunk):
-            piece = np.zeros((1, chunk), np.int32)
-            part = req.prompt[c0:c0 + chunk]
-            piece[0, :len(part)] = part
-            x_last, self.cache = self._prefill_chunk(
-                self.params, jnp.asarray(piece), self.cache, tables,
-                jnp.int32(slot), jnp.int32(c0), jnp.int32(L))
-        return self._chunk_lm_head(self.params, x_last)
+        W = self._chunk_width
+        share_len = n_shared * self.paged.block_size
+        start = min((share_len // W) * W, ((L - 1) // W) * W)
+        self._chunked[slot] = _ChunkedPrefill(
+            req=req, offset=start, share_len=share_len,
+            tables=jnp.asarray(self.paged.tables))
+        self.sched.note_chunked_started()
+
+    def _advance_chunked(self, slot: int) -> int:
+        """One prefill chunk for the slot's in-flight long prompt; the
+        final chunk also runs the lm head + first-token sample and
+        publishes the slot's cache len (making the sequence visible to
+        decode). Completed full blocks publish to the radix tree after
+        every chunk. Returns the budget tokens consumed."""
+        st = self._chunked[slot]
+        req = st.req
+        L = len(req.prompt)
+        W = self._chunk_width
+        piece = np.zeros((1, W), np.int32)
+        part = req.prompt[st.offset:st.offset + W]
+        piece[0, :len(part)] = part
+        st.x_last, self.cache = self._prefill_chunk(
+            self.params, jnp.asarray(piece), self.cache, st.tables,
+            jnp.int32(slot), jnp.int32(st.offset), jnp.int32(L),
+            jnp.int32(st.share_len))
+        st.offset += W
+        self.sched.note_prefill_chunk(W)
+        # publish completed read-only blocks: every position < offset is
+        # written and its write DISPATCHED, so a later sharer's reads are
+        # device-ordered behind the content
+        self.paged.publish_prompt_blocks(slot, req.prompt,
+                                         min(st.offset, L))
+        if st.offset >= L:
+            logits = self._chunk_lm_head(self.params, st.x_last)
+            tok, lp = self._sample_rows(logits, [req])
+            self.cache = self._set_len(
+                self.cache, jnp.int32(L), jnp.int32(slot))
+            del self._chunked[slot]
+            self.sched.note_chunked_admitted()
+            self._post_admit(req, slot, int(tok[0]), float(lp[0]))
+        return W
+
+    def _chunked_phase(self, interleave: bool, budget: int,
+                       spent: int) -> int:
+        """Advance in-flight chunked prefills, oldest first: ONE chunk
+        per step when interleaving, to completion otherwise (the legacy
+        convoy) — aborts observed between chunks either way. Returns the
+        updated budget spend. The single policy loop for both the
+        resumed-prefill and fresh-start paths in _admit."""
+        while self._chunked and (spent < budget or not interleave):
+            slot = next(iter(self._chunked))
+            if self._chunked[slot].req.aborted:
+                self._cancel_chunked(slot)
+                continue
+            spent += self._advance_chunked(slot)
+            if interleave:
+                break      # one chunk per step while one is in flight
+        return spent
+
+    def _cancel_chunked(self, slot: int) -> None:
+        """Abort/preempt a mid-flight chunked prefill: the slot and its
+        private blocks return immediately; blocks it already published
+        stay cached (their KV is valid — a pure function of the tokens)."""
+        del self._chunked[slot]
+        self.paged.release(slot)
+        self._free.append(slot)
+        self.sched.note_preempt()
 
     def _admit(self) -> None:
+        """The scheduler's prefill phase: spend this step's token quota on
+        prefill UNITS — one chunk of the oldest in-flight chunked prefill
+        first (FIFO), then admissions — and stop once the quota is spent
+        (the first unit always runs, so progress is guaranteed). Decode
+        dispatch follows in step(), so a long prompt can never convoy the
+        live streams. With ``interleave_prefill=False`` chunked prompts
+        run to completion inside one step (the legacy convoy, kept as the
+        scheduler-off baseline), still abort-checked between chunks."""
         from kubeflow_tpu.serving.paged_kv import blocks_for
 
         bs = self.paged.block_size
-        while True:
+        budget = self.sched.prefill_budget()
+        interleave = self.sched.cfg.interleave_prefill
+        # in-flight chunked prefills have priority, oldest first
+        spent = self._chunked_phase(interleave, budget, 0)
+        if self._chunked and interleave:
+            # a long prompt is mid-prefill: admissions wait their turn
+            # behind it (FIFO start order), decode proceeds regardless
+            return
+        while spent < budget or spent == 0:
             with self._lock:
                 if not self._waiting or not self._free:
                     return
@@ -504,26 +664,25 @@ class LLMEngine:
             # is exhausted the request waits at the HEAD of the queue (FIFO
             # under memory pressure — later arrivals must not starve it).
             # Full prompt blocks already cached (same tokens, same
-            # positions) are SHARED, not recomputed storage.
+            # positions) are SHARED, not recomputed storage — including for
+            # chunked prompts, whose private full blocks publish chunk by
+            # chunk (defer_publish) instead of at reserve time
             chunked = len(req.prompt) > self.buckets[-1]
-            # chunked prompts skip prefix SHARING: the chunk writer scatters
-            # every row it computes, and shared blocks must never be
-            # rewritten while other slots read them
             n_shared = self.paged.reserve(
                 slot, len(req.prompt), req.sampling.max_tokens,
                 min_blocks=blocks_for(len(req.prompt), bs),
-                prompt=None if chunked else req.prompt)
+                prompt=req.prompt, defer_publish=chunked)
             if n_shared is None:
                 with self._lock:
                     self._waiting.insert(0, req)
                 self._free.append(slot)
+                self.sched.note_stall()
                 return
             if chunked:
-                logits = self._admit_chunked(req, slot)
-                tok, lp = self._sample_rows(logits, [req])
-                self.cache = self._set_len(
-                    self.cache, jnp.int32(len(req.prompt)), jnp.int32(slot))
-                self._post_admit(req, slot, int(tok[0]), float(lp[0]))
+                self._start_chunked(req, slot, n_shared)
+                spent = self._chunked_phase(interleave, budget, spent)
+                if self._chunked and interleave:
+                    return
                 continue
             # batched admission: take the FIFO prefix of same-bucket
             # requests and pay ONE prefill+insert+sample dispatch for all
@@ -549,9 +708,12 @@ class LLMEngine:
                     with self._lock:
                         self._waiting.insert(0, nxt)
                     self._free.append(s2)
+                    self.sched.note_stall()
                     break
                 batch.append((nxt, s2, ns2))
             self._admit_prefill_batch(batch, bucket)
+            self.sched.note_admitted(len(batch))
+            spent += bucket * len(batch)
 
     def _admit_prefill_batch(self, batch, bucket: int) -> None:
         """One prefill + insert + first-token sample for a same-bucket

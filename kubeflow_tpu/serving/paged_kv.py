@@ -96,15 +96,148 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
 
 
+class _RadixNode:
+    """One cached KV block: the edge from its parent is the block's token
+    tuple, so a root-path spells a block-aligned prompt prefix."""
+
+    __slots__ = ("parent", "key", "children", "block", "tick")
+
+    def __init__(self, parent, key, block, tick):
+        self.parent = parent
+        self.key = key
+        self.children: dict[tuple, "_RadixNode"] = {}
+        self.block = block
+        self.tick = tick
+
+
+class RadixPrefixCache:
+    """Refcount-aware radix tree over FULL KV blocks (the vLLM/SGLang
+    radix-attention role). Each node owns one pool block whose KV is a
+    pure function of (tokens, positions, params); matching walks token
+    tuples from the root, so only identical prefixes at identical
+    positions share. Eviction is LRU over unpinned LEAVES — a node with
+    live descendants (or a nonzero refcount, tracked by the owner) can
+    never be unlinked, which makes stale partial chains structurally
+    impossible (the flaw the old flat hash map had to heal by hand)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._root = _RadixNode(None, None, None, 0)
+        self._by_block: dict[int, _RadixNode] = {}
+        self._tick = 0
+        self.evictions = 0
+
+    def _keys(self, prompt) -> list[tuple]:
+        bs = self.block_size
+        return [tuple(int(t) for t in prompt[k * bs:(k + 1) * bs])
+                for k in range(len(prompt) // bs)]
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def __contains__(self, block: int) -> bool:
+        return block in self._by_block
+
+    def blocks(self) -> set:
+        return set(self._by_block)
+
+    def match(self, prompt) -> list[int]:
+        """Block ids of the longest cached block-aligned prefix of
+        ``prompt`` (LRU-touching the whole path)."""
+        node, out = self._root, []
+        for key in self._keys(prompt):
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._tick += 1
+            child.tick = self._tick
+            out.append(child.block)
+            node = child
+        return out
+
+    def insert(self, prompt, blocks, n_blocks: Optional[int] = None) -> list:
+        """Publish ``blocks[k]`` as the cached KV for prompt block k, for
+        every FULL block (or the first ``n_blocks``). Existing nodes are
+        walked through unchanged — a concurrent publisher keeps the first
+        registration and the caller's copy stays private. Returns the
+        block ids actually registered."""
+        keys = self._keys(prompt)
+        if n_blocks is not None:
+            keys = keys[:n_blocks]
+        node, registered = self._root, []
+        for k, key in enumerate(keys):
+            if k >= len(blocks):
+                break
+            child = node.children.get(key)
+            if child is None:
+                blk = int(blocks[k])
+                if blk in self._by_block:
+                    break          # one node per block, ever
+                self._tick += 1
+                child = _RadixNode(node, key, blk, self._tick)
+                node.children[key] = child
+                self._by_block[blk] = child
+                registered.append(blk)
+            node = child
+        return registered
+
+    def evictable_count(self, refs: dict) -> int:
+        """Nodes reclaimable under ``refs`` pins: a node counts iff its
+        whole subtree is unpinned (leaves-first eviction can reach it)."""
+        def rec(node):
+            cnt, ok_all = 0, True
+            for c in node.children.values():
+                c_cnt, c_ok = rec(c)
+                cnt += c_cnt
+                ok_all = ok_all and c_ok
+            if node is self._root:
+                return cnt, True
+            ok = ok_all and refs.get(node.block, 0) == 0
+            return cnt + (1 if ok else 0), ok
+        return rec(self._root)[0]
+
+    def evict_lru(self, n: int, refs: dict) -> list[int]:
+        """Unlink up to ``n`` unpinned leaves, LRU-first (evicting a leaf
+        may expose its parent as the next candidate). Pinned blocks and
+        interior nodes are untouchable. One scan seeds a tick-ordered
+        heap; exposed parents push locally — O(N log N) per call, not
+        O(n*N) rescans in the admission hot path."""
+        import heapq
+
+        heap = [(node.tick, blk) for blk, node in self._by_block.items()
+                if not node.children and refs.get(blk, 0) == 0]
+        heapq.heapify(heap)
+        freed: list[int] = []
+        while heap and len(freed) < n:
+            tick, blk = heapq.heappop(heap)
+            node = self._by_block.get(blk)
+            if (node is None or node.children or node.tick != tick
+                    or refs.get(blk, 0) > 0):
+                continue                       # stale heap entry
+            parent = node.parent
+            del parent.children[node.key]
+            del self._by_block[blk]
+            freed.append(blk)
+            self.evictions += 1
+            if (parent is not self._root and not parent.children
+                    and refs.get(parent.block, 0) == 0):
+                heapq.heappush(heap, (parent.tick, parent.block))
+        return freed
+
+
 @dataclasses.dataclass
 class PagedKV:
     """The engine-facing bundle: pool dict + host block tables/allocator,
-    with automatic prefix caching (the vLLM APC role): full prompt blocks
-    are content-hashed (position-chained, so only identical prefixes at
-    identical positions match) and shared across requests by refcount.
-    Shared blocks are never rewritten — the KV inside is a pure function
-    of (tokens, positions, params). When a block's refcount hits zero it
-    stays cached and evictable (LRU) until the pool needs it back."""
+    with automatic prefix caching (the vLLM APC role) through a
+    refcounted RADIX tree: full prompt blocks are keyed by their token
+    tuples along the root path (position-dependence from tree depth) and
+    shared across requests by refcount. Shared blocks are never rewritten
+    — the KV inside is a pure function of (tokens, positions, params).
+    When a block's refcount hits zero it stays cached and LRU-evictable
+    (leaves first) until the pool needs it back. Chunked prefills
+    participate too: they share cached prefixes at reserve time (with
+    ``defer_publish=True``) and publish completed read-only blocks chunk
+    by chunk via ``publish_prompt_blocks``."""
 
     cfg: llama.LlamaConfig
     max_batch: int
@@ -127,90 +260,54 @@ class PagedKV:
         self._slot_blocks: dict[int, list[int]] = {}
         # prefix cache state
         self._ref: dict[int, int] = {}              # block -> live users
-        self._block_of_hash: dict[str, int] = {}    # insertion order = LRU
-        self._hash_of_block: dict[int, str] = {}
-        self.prefix_hits = 0                        # observability
-
-    # ---- prefix hashing ----
-
-    def _prefix_hashes(self, prompt) -> list[str]:
-        """One chained hash per FULL prompt block (position-dependence is
-        implied by the chain: block k's hash folds in blocks 0..k-1)."""
-        import hashlib
-
-        out, h = [], hashlib.sha256()
-        n_full = len(prompt) // self.block_size
-        for k in range(n_full):
-            chunk = prompt[k * self.block_size:(k + 1) * self.block_size]
-            h.update((",".join(map(str, chunk)) + ";").encode())
-            out.append(h.hexdigest()[:24])
-        return out
-
-    def _register_hash(self, hsh: str, blk: int) -> None:
-        """Point ``hsh`` at ``blk``, fully unlinking any stale mapping: a
-        partially-evicted chain can leave hsh -> old_blk behind, and
-        overwriting only one direction would orphan old_blk forever
-        (release() skips cached blocks; eviction iterates hashes)."""
-        old = self._block_of_hash.get(hsh)
-        if old is not None and old != blk:
-            self._hash_of_block.pop(old, None)
-            if self._ref.get(old, 0) == 0:
-                self.allocator.free([old])
-        self._block_of_hash[hsh] = blk
-        self._hash_of_block[blk] = hsh
+        self.radix = RadixPrefixCache(self.block_size)
+        self.prefix_hits = 0                        # blocks shared
+        self.prefix_queries = 0                     # full blocks looked up
 
     def _alloc_evicting(self, n: int):
-        """Allocator alloc with LRU eviction of unreferenced cached blocks.
-        A doomed allocation (free + idle-cached < n) returns None WITHOUT
+        """Allocator alloc with LRU eviction of unpinned cached blocks.
+        A doomed allocation (free + evictable < n) returns None WITHOUT
         evicting: a head-of-line request retrying every step must not
         flush everyone else's prefix cache for nothing."""
         ids = self.allocator.alloc(n)
         if ids is not None:
             return ids
-        idle_cached = sum(1 for b in self._hash_of_block
-                          if self._ref.get(b, 0) == 0)
-        if self.allocator.free_blocks + idle_cached < n:
+        if (self.allocator.free_blocks
+                + self.radix.evictable_count(self._ref)) < n:
             return None
-        for hsh in list(self._block_of_hash):
-            if self.allocator.free_blocks >= n:
-                break
-            blk = self._block_of_hash[hsh]
-            if self._ref.get(blk, 0) == 0:
-                del self._block_of_hash[hsh]
-                del self._hash_of_block[blk]
-                self.allocator.free([blk])
+        self.allocator.free(self.radix.evict_lru(
+            n - self.allocator.free_blocks, self._ref))
         return self.allocator.alloc(n)
 
     # ---- host-side scheduling ----
 
     def reserve(self, slot: int, prompt_len: int, max_tokens: int,
-                min_blocks: int = 0, prompt=None) -> Optional[int]:
+                min_blocks: int = 0, prompt=None,
+                defer_publish: bool = False) -> Optional[int]:
         """Reserve every block the request can ever touch (prompt + all
         generated tokens) so decode never exhausts the pool mid-flight.
         With ``prompt`` tokens and prefix caching on, the longest cached
-        block-aligned prefix is SHARED (refcounted) instead of reallocated.
-        Returns the number of shared prefix blocks, or None if the pool
-        cannot satisfy the reservation. ``min_blocks`` lets prefill demand
-        bucket-coverage."""
+        block-aligned prefix is SHARED (refcounted) instead of
+        reallocated. Returns the number of shared prefix blocks, or None
+        if the pool cannot satisfy the reservation. ``min_blocks`` lets
+        prefill demand bucket-coverage. ``defer_publish`` (chunked
+        prefill) skips registering the private full-prompt blocks — their
+        content lands over FUTURE steps, so the engine publishes them
+        chunk by chunk instead (a premature match would read garbage)."""
         need = max(blocks_for(prompt_len + max_tokens, self.block_size),
                    min_blocks)
         need = min(need, self.max_blocks_per_seq)
         shared: list[int] = []
-        hashes: list[str] = []
+        n_full = 0
         if self.prefix_cache and prompt is not None:
-            hashes = self._prefix_hashes(prompt)
-            for hsh in hashes:
-                blk = self._block_of_hash.get(hsh)
-                if blk is None:
-                    break
-                shared.append(blk)
+            n_full = len(prompt) // self.block_size
+            self.prefix_queries += n_full
+            shared = self.radix.match(prompt)
+            for blk in shared:
                 # refcount BEFORE any allocation below: eviction skips
                 # referenced blocks, so the allocator can never hand a
                 # shared block back out as someone's private block
                 self._ref[blk] = self._ref.get(blk, 0) + 1
-                # LRU touch
-                self._block_of_hash.pop(hsh)
-                self._block_of_hash[hsh] = blk
         private = self._alloc_evicting(need - len(shared))
         if private is None:
             for blk in shared:          # roll the refcounts back
@@ -219,19 +316,34 @@ class PagedKV:
                     self._ref.pop(blk, None)
             return None
         self.prefix_hits += len(shared)
-        # private blocks holding FULL prompt blocks become cacheable: after
-        # prefill-insert they contain exactly the hashed content
-        for k, hsh in enumerate(hashes[len(shared):], start=len(shared)):
-            blk = private[k - len(shared)]
-            self._register_hash(hsh, blk)
         for blk in private:
             self._ref[blk] = self._ref.get(blk, 0) + 1
         ids = shared + private
+        if (self.prefix_cache and prompt is not None
+                and not defer_publish):
+            # private blocks holding FULL prompt blocks become cacheable:
+            # after this step's prefill-insert they contain exactly the
+            # keyed content, ordered before any later sharer's reads
+            self.radix.insert(prompt, ids, n_blocks=n_full)
         self._slot_blocks[slot] = ids
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
         row[:len(ids)] = ids
         self.tables[slot] = row
         return len(shared)
+
+    def publish_prompt_blocks(self, slot: int, prompt,
+                              upto_tokens: int) -> int:
+        """Chunked-prefill publication: register this slot's blocks whose
+        content is complete (every position < ``upto_tokens`` written and
+        dispatched) as shareable read-only radix nodes. Safe mid-prefill
+        and after an abort — the published KV is already valid."""
+        if not self.prefix_cache:
+            return 0
+        ids = self._slot_blocks.get(slot)
+        if not ids:
+            return 0
+        n = min(int(upto_tokens), len(prompt)) // self.block_size
+        return len(self.radix.insert(prompt, ids, n_blocks=n))
 
     def release(self, slot: int) -> None:
         ids = self._slot_blocks.pop(slot, None)
@@ -239,17 +351,19 @@ class PagedKV:
             self._ref[blk] = self._ref.get(blk, 1) - 1
             if self._ref[blk] <= 0:
                 self._ref.pop(blk, None)
-                if blk in self._hash_of_block:
+                if blk in self.radix:
                     continue    # stays cached + evictable, not free-listed
                 self.allocator.free([blk])
         self.tables[slot] = 0
 
     @property
     def reclaimable_blocks(self) -> int:
-        """Free-list blocks plus cached blocks nothing references."""
-        cached_idle = sum(1 for b in self._hash_of_block
-                          if self._ref.get(b, 0) == 0)
-        return self.allocator.free_blocks + cached_idle
+        """Free-list blocks plus cached blocks eviction could reach."""
+        return (self.allocator.free_blocks
+                + self.radix.evictable_count(self._ref))
+
+    def cached_block_ids(self) -> set:
+        return self.radix.blocks()
 
     def slot_blocks(self, slot: int) -> list[int]:
         return list(self._slot_blocks.get(slot, []))
@@ -383,7 +497,7 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
 
 
 def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
-                        tables, slot, offset, length):
+                        tables, slot, offset, length, share_len=0):
     """Chunked prefill straight into the paged pool (vLLM chunked-prefill
     role): processes `tokens` [1, C] as positions offset..offset+C-1 of
     `slot`'s sequence, attending to everything the slot's blocks already
@@ -392,13 +506,17 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
     compile count stays O(1) in prompt length (offset/length are traced).
 
     Rows at positions >= `length` (the final chunk's padding) scatter to
-    block 0 — the pool's scratch block — never into live data. Returns
-    (x_last [1, D]: the PRE-final-norm hidden state at the chunk's last
-    TRUE row — _lm_head applies final_norm; the caller runs it ONCE on
-    the final chunk's value rather than paying a full-vocab matmul per
-    chunk — and the updated cache). cache["len"] for the slot is NOT advanced here; the engine
-    sets it once after the last chunk (decode masks by len, so partial
-    writes stay invisible)."""
+    block 0 — the pool's scratch block — never into live data; so do rows
+    at positions < `share_len` (a radix-shared prefix): their KV is
+    ALREADY resident in shared read-only blocks, which must never be
+    rewritten while other slots read them (the re-computed values are
+    bit-identical, so attention over the view stays exact either way).
+    Returns (x_last [1, D]: the PRE-final-norm hidden state at the
+    chunk's last TRUE row — _lm_head applies final_norm; the caller runs
+    it ONCE on the final chunk's value rather than paying a full-vocab
+    matmul per chunk — and the updated cache). cache["len"] for the slot
+    is NOT advanced here; the engine sets it once after the last chunk
+    (decode masks by len, so partial writes stay invisible)."""
     _, c = tokens.shape
     bs = cache["k"].shape[2]
     inv_freq = jnp.asarray(rope_frequencies(
@@ -408,9 +526,10 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
     pos = offset + jnp.arange(c)                          # [C] absolute
     valid = pos < length
     # destination rows: real rows land in the slot's table blocks; pad
-    # rows land in scratch block 0 (row p % bs — garbage, never read)
+    # rows and shared-prefix rows land in scratch block 0 (row p % bs —
+    # garbage / duplicate values, never read)
     blk = jnp.where(
-        valid,
+        valid & (pos >= share_len),
         tables[slot, jnp.clip(pos // bs, 0, tables.shape[1] - 1)],
         0)
     off = pos % bs

@@ -25,6 +25,13 @@ Env contract (all optional except the uri for real weights):
   KFT_MESH          e.g. "tensor=4": shard params + KV pool over the
                     pod's chips (distributed serving; same topology-env
                     contract as training rendezvous)
+  KFT_PREFILL_QUOTA          step-scheduler prefill token quota (0 = auto:
+                             the largest prefill bucket)
+  KFT_INTERLEAVE_PREFILL     "0" disables chunked-prefill interleaving
+                             (legacy convoy admission)
+  KFT_ADAPTIVE_DECODE_CHUNK  "0" disables decode-chunk trimming under
+                             queue pressure
+  KFT_RADIX_CACHE            "0" disables radix prefix-cache sharing
 """
 
 from __future__ import annotations
@@ -49,6 +56,24 @@ def init_storage(env: Mapping[str, str]) -> Optional[str]:
         return env.get("KFT_MODEL_DIR") or None
     dest = env.get("KFT_MODEL_DIR") or "/mnt/models"
     return storage.download(uri, dest)
+
+
+def scheduler_from_env(env: Mapping[str, str]):
+    """KFT_PREFILL_QUOTA / KFT_INTERLEAVE_PREFILL /
+    KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE -> SchedulerConfig (None
+    when nothing is set, keeping the engine defaults)."""
+    from kubeflow_tpu.serving.scheduler import SchedulerConfig
+
+    keys = ("KFT_PREFILL_QUOTA", "KFT_INTERLEAVE_PREFILL",
+            "KFT_ADAPTIVE_DECODE_CHUNK", "KFT_RADIX_CACHE")
+    if not any(env.get(k) for k in keys):
+        return None
+    on = lambda k: env.get(k, "1") not in ("0", "false", "no", "")
+    return SchedulerConfig(
+        prefill_tokens_per_step=int(env.get("KFT_PREFILL_QUOTA", "0") or 0),
+        interleave_prefill=on("KFT_INTERLEAVE_PREFILL"),
+        adaptive_decode_chunk=on("KFT_ADAPTIVE_DECODE_CHUNK"),
+        radix_cache=on("KFT_RADIX_CACHE"))
 
 
 def build_model_from_env(env: Mapping[str, str]) -> Model:
@@ -77,7 +102,8 @@ def build_model_from_env(env: Mapping[str, str]) -> Model:
             name, model_dir, dtype=dtype, mesh=mesh,
             max_batch=int(env.get("KFT_MAX_BATCH", 8)),
             max_seq=int(env.get("KFT_MAX_SEQ", 1024)),
-            compile_cache_dir=cache)
+            compile_cache_dir=cache,
+            scheduler=scheduler_from_env(env))
     raise ValueError(f"unsupported KFT_MODEL_FORMAT {fmt!r}")
 
 

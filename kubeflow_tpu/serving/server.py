@@ -103,14 +103,25 @@ class ModelServer:
                         f"kft_requests_in_flight {max(0, outer.in_flight - 1)}\n"
                     )
                     # per-model engine gauges (models exposing stats());
-                    # tolerate hot unload racing the scrape
+                    # tolerate hot unload racing the scrape. A nested dict
+                    # is a counter FAMILY (e.g. the step scheduler's
+                    # "sched" set) flattened to kft_model_<family>_<k> —
+                    # occupancy / queue-depth / prefix-hit / preempt
+                    # counters the serving controller autoscales on
                     for mname in outer.repository.names():
                         try:
                             mdl = outer.repository.get(mname)
                             stats = getattr(mdl, "stats", dict)() or {}
                         except ModelMissing:
                             continue
+                        flat = []
                         for k, v in stats.items():
+                            if isinstance(v, dict):
+                                flat.extend((f"{k}_{k2}", v2)
+                                            for k2, v2 in v.items())
+                            else:
+                                flat.append((k, v))
+                        for k, v in flat:
                             text += (f'kft_model_{k}'
                                      f'{{model="{mname}"}} {v}\n')
                     body = text.encode()

@@ -14,6 +14,11 @@ import enum
 from typing import Any, Optional
 
 from kubeflow_tpu.api.types import TPUSpec
+# The predictor-spec view of the continuous-batching step scheduler
+# (serving/scheduler.py is pure stdlib, so the control plane can carry it
+# without importing jax): per-step prefill token quota, chunked-prefill
+# interleaving, adaptive decode-chunk trims, radix prefix cache.
+from kubeflow_tpu.serving.scheduler import SchedulerConfig as SchedulerPolicy
 
 
 @dataclasses.dataclass
@@ -61,6 +66,10 @@ class PredictorSpec:
     canary_traffic_percent: Optional[int] = None   # % to the LATEST revision
     tpu: Optional[TPUSpec] = None
     env: dict[str, str] = dataclasses.field(default_factory=dict)
+    # LLM runtimes only: step-scheduler knobs, stamped onto the predictor
+    # pod as KFT_PREFILL_QUOTA / KFT_INTERLEAVE_PREFILL /
+    # KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE by the ISVC controller
+    scheduler: Optional[SchedulerPolicy] = None
 
 
 @dataclasses.dataclass
@@ -110,7 +119,11 @@ def inference_service_from_dict(d: dict) -> InferenceService:
     tpu = p.pop("tpu", None)
     if isinstance(tpu, dict):
         tpu = TPUSpec(**tpu)
-    predictor = PredictorSpec(model_format=fmt, tpu=tpu, **p)
+    sched = p.pop("scheduler", None)
+    if isinstance(sched, dict):
+        sched = SchedulerPolicy(**sched)
+    predictor = PredictorSpec(model_format=fmt, tpu=tpu, scheduler=sched,
+                              **p)
     return InferenceService(
         name=d["name"], namespace=d.get("namespace", "default"),
         labels=dict(d.get("labels", {})), predictor=predictor)

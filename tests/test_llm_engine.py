@@ -195,10 +195,11 @@ def test_doomed_reservation_does_not_flush_cache(tiny):
     kv = _paged(cfg, num_blocks=5)               # 4 usable
     assert kv.reserve(0, 16, 8, prompt=list(range(16))) == 0  # 3 blocks
     kv.release(0)                                 # 2 cached idle, 3 free...
-    cached_before = dict(kv._block_of_hash)
+    cached_before = kv.cached_block_ids()
     # needs 8 > 4 usable: doomed — capped at max_blocks_per_seq 8
     assert kv.reserve(1, 40, 24, prompt=list(range(200, 240))) is None
-    assert kv._block_of_hash == cached_before     # cache untouched
+    assert kv.cached_block_ids() == cached_before   # cache untouched
+    assert kv.radix.evictions == 0
 
 
 def test_prefix_cache_partial_eviction_leaks_no_blocks(tiny):
@@ -586,14 +587,10 @@ def test_chunked_prefill_releases_pool(tiny):
     cfg, params = tiny
     eng = LLMEngine(params, cfg, max_batch=2, max_seq=128,
                     prefill_buckets=(16,))
-    free0 = eng.paged.allocator.free_blocks + sum(
-        1 for b in eng.paged._hash_of_block
-        if eng.paged._ref.get(b, 0) == 0)
+    free0 = eng.paged.reclaimable_blocks
     eng.generate([[(11 * i) % 250 + 1 for i in range(40)]],
                  SamplingParams(max_tokens=4))
-    free1 = eng.paged.allocator.free_blocks + sum(
-        1 for b in eng.paged._hash_of_block
-        if eng.paged._ref.get(b, 0) == 0)
+    free1 = eng.paged.reclaimable_blocks
     assert free0 == free1
 
 
