@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -94,6 +94,32 @@ test-serving-sched:
 		print('serving-sched bench OK: rps=' + str(e['requests_per_sec']) \
 			+ ' prefix_hit_rate=' + str(e['prefix_hit_rate']) \
 			+ ' e2e_vs_device_only=' + str(e['e2e_vs_device_only']))"
+
+# speculative decoding + sharded-kernel e2e (ISSUE 11): the drafter/
+# token-identity suite and the sharded Pallas-vs-gather parity suite,
+# then a bounded spec-vs-baseline bench smoke. Two independent teeth
+# (like test-serving-sched): bench.py exits nonzero unless greedy output
+# was TOKEN-IDENTICAL to the non-speculative path and
+# accepted_tokens_per_step held its >= 1.0 floor; the JSON contract is
+# then re-checked from the captured file so a silently-vanished counter
+# or ratio regresses visibly.
+SPEC_SMOKE_JSON := /tmp/kft-spec-smoke.json
+test-spec-decode:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_decode.py \
+		tests/test_paged_attention_kernel.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --spec-smoke > $(SPEC_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(SPEC_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; s = e['spec']['sched']; \
+		assert e['token_identical'] is True, ('spec decode diverged', d); \
+		assert e['accepted_tokens_per_step'] >= 1.0, d; \
+		assert 'spec_decode_speedup' in e and 'device_step_speedup' in e, d; \
+		assert s['spec_dispatches_total'] > 0, d; \
+		assert s['spec_committed_tokens_total'] >= s['spec_slot_rounds_total'], d; \
+		print('spec-decode bench OK: accepted/step=' \
+			+ str(e['accepted_tokens_per_step']) \
+			+ ' device_step_speedup=' + str(e['device_step_speedup']) \
+			+ ' e2e_speedup=' + str(e['spec_decode_speedup']))"
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -33,10 +33,14 @@ def _xla_attention(q, k, v, *, causal: bool, q_offset=0, bias=None):
     if bias is not None:
         logits = logits + bias
     if causal:
-        q_pos = jnp.arange(sq) + q_offset
+        # q_offset may be a scalar (all rows share one offset — prefill /
+        # chunked prefill) or a [B] array (per-slot offsets — the batched
+        # speculative-decode verify step); either broadcasts to [B?, Sq]
+        q_pos = jnp.arange(sq)[None, :] + jnp.atleast_1d(
+            jnp.asarray(q_offset))[:, None]
         kv_pos = jnp.arange(skv)
-        mask = q_pos[:, None] >= kv_pos[None, :]
-        logits = jnp.where(mask[None, None, None, :, :], logits, -1e30)
+        mask = q_pos[:, :, None] >= kv_pos[None, None, :]   # [B|1, Sq, Skv]
+        logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, vf)
     return out.reshape(b, sq, h, d).astype(q.dtype)

@@ -36,6 +36,17 @@ Design (decode only — one query token per slot):
 ``interpret=True`` runs the identical kernel logic on CPU (tier-1 tests);
 the gather path in ``serving/paged_kv.py`` stays available as the
 reference oracle behind the same ``kernel=`` switch.
+
+Mesh partitioning: grouped-query attention is embarrassingly parallel
+over KV heads — every query-head group attends ONLY its own KV head, and
+the online-softmax state never crosses groups. So a tensor-sharded
+engine (KV pool sharded on the kv-head dim, q sharded on heads by the
+same factor) runs the kernel under ``shard_map``
+(``paged_decode_attention_sharded``): each shard streams its LOCAL pool
+blocks through VMEM against its local query heads, block tables and
+lengths replicated, zero collectives. XLA cannot auto-partition a Mosaic
+call, which is why the gather oracle used to be the only sharded path;
+the shard_map wrapper removes that downgrade.
 """
 
 from __future__ import annotations
@@ -46,6 +57,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30      # same mask value as the gather path (decode_attention)
 
@@ -164,3 +176,65 @@ def paged_decode_attention(q, k_pool, v_pool, tables, kv_len, *,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=interpret,
     )(kv_len, tables, q, k2, v2)
+
+
+def shard_unsupported_reason(mesh, n_kv_heads: int,
+                             axis: str = "tensor"):
+    """Why ``paged_decode_attention_sharded`` cannot run on this mesh, or
+    None when it can. The one hard constraint is the engine's own pool
+    constraint: the KV-head dim must split evenly over ``axis``. Mesh
+    axes the specs don't mention (data/fsdp in a mixed topology) are
+    fine — shard_map treats them as replication, which the serving
+    engine's tensor-only pool sharding already guarantees."""
+    if mesh is None:
+        return None
+    sizes = dict(getattr(mesh, "shape", {}) or {})
+    tp = int(sizes.get(axis, 1))
+    if tp > 1 and n_kv_heads % tp:
+        return (f"n_kv_heads={n_kv_heads} not divisible by "
+                f"{axis}={tp}")
+    return None
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: Mosaic calls have no replication /
+    varying-mesh-axes rule, so the check must be off (the specs here are
+    correct by construction — per-KV-head groups are independent)."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (TypeError, AttributeError):
+        from jax.experimental.shard_map import shard_map as _old
+
+        return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=False)
+
+
+def paged_decode_attention_sharded(q, k_pool, v_pool, tables, kv_len, *,
+                                   mesh, axis: str = "tensor",
+                                   interpret: bool = False):
+    """``paged_decode_attention`` partitioned over the mesh's heads/KV
+    axis with shard_map: q [B, H, D] shards on H, pools
+    [NB, bs, KV_H, D] on KV_H, block tables and lengths replicated —
+    each shard's table row names the same pool blocks, but only the
+    local kv-head slice of them is resident per chip. No collectives:
+    softmax state is private to each query-head group.
+
+    Falls back to the unwrapped kernel when the mesh doesn't shard
+    ``axis`` (a 1-sized axis needs no partitioning); raises for
+    topologies the kernel cannot shard (see shard_unsupported_reason) —
+    callers decide the gather downgrade, not this function."""
+    kvh = k_pool.shape[2]
+    reason = shard_unsupported_reason(mesh, kvh, axis)
+    if reason is not None:
+        raise ValueError(f"cannot shard paged attention: {reason}")
+    if mesh is None or int(dict(mesh.shape).get(axis, 1)) <= 1:
+        return paged_decode_attention(q, k_pool, v_pool, tables, kv_len,
+                                      interpret=interpret)
+    kern = functools.partial(paged_decode_attention, interpret=interpret)
+    wrapped = _shard_map(
+        kern, mesh,
+        in_specs=(P(None, axis, None), P(None, None, axis, None),
+                  P(None, None, axis, None), P(None, None), P(None)),
+        out_specs=P(None, axis, None))
+    return wrapped(q, k_pool, v_pool, tables, kv_len)

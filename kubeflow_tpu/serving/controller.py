@@ -253,6 +253,9 @@ class ServingController:
                 "KFT_ADAPTIVE_DECODE_CHUNK":
                     "1" if sp.adaptive_decode_chunk else "0",
                 "KFT_RADIX_CACHE": "1" if sp.radix_cache else "0",
+                "KFT_SPEC_DECODE": "1" if sp.spec_decode else "0",
+                "KFT_SPEC_K": str(sp.spec_k),
+                "KFT_SPEC_DRAFTER": sp.spec_drafter,
             })
         predictor_env.setdefault("KFT_MODEL_DIR", "/mnt/models")
         # storage-initializer injection (the reference does this in a pod

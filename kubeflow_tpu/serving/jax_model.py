@@ -294,6 +294,10 @@ class LLMModel(Model):
             "kv_free_blocks": eng.paged.allocator.free_blocks,
             "kv_reclaimable_blocks": eng.paged.reclaimable_blocks,
             "prefix_cache_hits_total": eng.paged.prefix_hits,
+            # a decode-kernel downgrade the caller didn't ask for (gpu
+            # platform / unshardable mesh topology) is ~3.7x decode
+            # bandwidth quietly lost — it must be visible on /metrics
+            "kernel_downgrades_total": eng.kernel_downgrades,
             "sched": eng.scheduler_stats(),
         }
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 import threading
 from typing import Any, Optional, Sequence
 
@@ -27,7 +28,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.models import llama
-from kubeflow_tpu.serving.scheduler import SchedulerConfig, StepScheduler
+from kubeflow_tpu.serving.scheduler import (
+    SchedulerConfig, StepScheduler, ceil_pow2,
+)
+
+logger = logging.getLogger(__name__)
+
+# kernel-downgrade reasons already logged this process: the event is
+# counted per engine (kft_model_kernel_downgrades_total) but LOGGED once —
+# a fleet restarting 128 replicas must not print 128 identical warnings
+_downgrades_logged: set = set()
+
+
+def _log_downgrade_once(requested: str, reason: str) -> None:
+    if reason in _downgrades_logged:
+        return
+    _downgrades_logged.add(reason)
+    logger.warning(
+        "decode kernel %r downgraded to 'gather' (%s): losing the "
+        "block-resident fast path's bandwidth advantage", requested, reason)
 
 
 @dataclasses.dataclass
@@ -96,6 +115,23 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
 
 
+def greedy_argmax(logits):
+    """Greedy pick with an EXPLICIT stable lowest-index tie-break.
+
+    Exact logit ties are routine in bf16 (activations quantize to 8
+    mantissa bits), and ``jnp.argmax``'s tie winner is formally
+    first-index but travels through backend-specific reduction trees.
+    This construction — min index among maximizers — is deterministic by
+    value comparison alone, so every path that greedy-decodes (decode
+    sampler, first-token sampler, speculative verify) breaks ties the
+    same way on the same values. Works on any [..., V] logits."""
+    vocab = logits.shape[-1]
+    is_max = logits == jnp.max(logits, axis=-1, keepdims=True)
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                   logits.ndim - 1)
+    return jnp.min(jnp.where(is_max, idx, vocab), axis=-1).astype(jnp.int32)
+
+
 def sample_logits(logits, rng, temperature, top_k, top_p,
                   greedy_only: bool = False):
     """On-device sampling: greedy when temperature==0, else
@@ -106,7 +142,7 @@ def sample_logits(logits, rng, temperature, top_k, top_p,
     sort is O(V log V) bitonic passes on TPU and dominates the decode
     step for greedy batches, which are the common serving case."""
     vocab = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
+    greedy = greedy_argmax(logits)
     if greedy_only:
         return greedy.astype(jnp.int32)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
@@ -148,27 +184,30 @@ class LLMEngine:
                  mesh=None,
                  scheduler: Optional[SchedulerConfig] = None):
         from kubeflow_tpu.serving.paged_kv import (
-            PagedKV, _lm_head as lm_head_fn, _resolve_decode_kernel,
-            paged_prefill_chunk as paged_prefill_chunk_fn,
+            PagedKV, _lm_head as lm_head_fn, paged_prefill_chunk
+            as paged_prefill_chunk_fn, paged_verify_step
+            as paged_verify_step_fn, resolve_decode_kernel,
         )
 
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
         # decode-attention path (paged_kv module docstring): the
-        # block-resident Pallas kernel is the TPU default; the gather view
-        # stays as the reference oracle AND the only path XLA can
-        # auto-partition, so any multi-chip mesh pins it. Resolution is
+        # block-resident Pallas kernel is the TPU default — including
+        # under a mesh, where it runs shard_map'd over the heads/KV
+        # tensor axis (ops/pallas_paged_attention). Resolution is
         # delegated to paged_kv so self.kernel always names the path the
-        # decode step actually executes (e.g. gpu downgrades pallas).
-        resolved = _resolve_decode_kernel(kernel)
-        if mesh is not None:
-            if kernel == "pallas":
-                raise ValueError(
-                    "kernel='pallas' cannot be auto-partitioned over a "
-                    "mesh; use kernel='gather' (or shard_map the engine)")
-            resolved = "gather"
+        # decode step actually executes; a downgrade the caller did not
+        # ask for (gpu, or an unshardable mesh topology) is COUNTED
+        # (kft_model_kernel_downgrades_total) and logged once instead of
+        # silently losing ~3.7x decode bandwidth.
+        resolved, downgrade = resolve_decode_kernel(
+            kernel, mesh=mesh, n_kv_heads=cfg.n_kv_heads)
         self.kernel = resolved
+        self.kernel_downgrades = 0
+        if downgrade is not None:
+            self.kernel_downgrades = 1
+            _log_downgrade_once(kernel, downgrade)
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.buckets = sorted(b for b in prefill_buckets if b <= max_seq)
@@ -256,6 +295,17 @@ class LLMEngine:
         # by the quota so one chunk always fits one step's budget
         self._chunk_width = max(1, min(self.buckets[-1],
                                        self.sched.prefill_budget()))
+        # speculative decoding (scheduler knob): host-side drafter +
+        # batched verify step. The drafter proposes per-stream token
+        # continuations; one _verify dispatch scores all of them and the
+        # accepted prefix commits — greedy outputs token-identical to
+        # the non-speculative path, >=1 token per verify always.
+        self.spec = None
+        if self.sched.cfg.spec_decode:
+            from kubeflow_tpu.serving.spec_decode import make_drafter
+
+            self.spec = make_drafter(self.sched.cfg.spec_drafter,
+                                     self.sched.cfg.spec_k)
 
         self._prefill = jax.jit(
             lambda p, toks, lens, cache: llama.prefill(
@@ -284,6 +334,26 @@ class LLMEngine:
         self._decode = jax.jit(
             self._decode_impl, donate_argnums=(2,),
             static_argnames=("greedy_only", "kernel", "chunk_len"))
+        # speculative verify: greedy target chain + chosen-token logprobs
+        # for a [B, S] candidate batch in ONE dispatch. S is pow2-padded
+        # by the caller, so the compile count is log2(spec_k+1) — the
+        # same static-width scheme the adaptive decode chunk uses.
+        def _verify_impl(p, toks, cache, tables, limit):
+            logits, cache = paged_verify_step_fn(
+                p, toks, self.cfg, cache, tables, limit)
+            # the SAME stable tie-break the decode sampler uses: the
+            # token-identity guarantee rests on both paths picking the
+            # same greedy token from the same logit values
+            nxt = greedy_argmax(logits)
+            lp = jnp.take_along_axis(
+                logits, nxt[..., None], axis=-1)[..., 0] \
+                - jax.nn.logsumexp(logits, axis=-1)
+            return nxt, lp, cache
+
+        self._verify = jax.jit(_verify_impl, donate_argnums=(2,))
+        self._set_lens = jax.jit(
+            lambda cache, lens: {**cache, "len": lens},
+            donate_argnums=(0,))
         self._merge_tok = jax.jit(
             lambda carry, upd, mask: jnp.where(mask, upd, carry))
         self._insert_batch = jax.jit(self._insert_batch_impl,
@@ -303,7 +373,8 @@ class LLMEngine:
         def one_step(carry, rng_step):
             token, cache = carry
             logits, cache = paged_decode_step(
-                params, token, self.cfg, cache, tables, kernel=kernel)
+                params, token, self.cfg, cache, tables, kernel=kernel,
+                mesh=self.mesh)
             nxt = sample_logits(logits, rng_step, temperature, top_k,
                                 top_p, greedy_only=greedy_only)
             # chosen-token logprob under the MODEL distribution (OpenAI
@@ -421,6 +492,34 @@ class LLMEngine:
                 if st.req.id in aborted:
                     self._cancel_chunked(slot)
         self._admit()
+        finished_pre: list[GenRequest] = []
+        if self.spec is not None and self._active:
+            if all(r.sampling.temperature == 0
+                   for r in self._active.values()):
+                # speculative path: flush any pipelined chunk first (its
+                # tokens are this step's draft context), then one
+                # draft+verify round — synchronous by construction, the
+                # drafter needs the committed tokens back
+                if self._inflight is not None:
+                    prev, self._inflight = self._inflight, None
+                    finished_pre = self._process_chunk(prev)
+                if not self._active:
+                    return finished_pre
+                spec_finished = self._spec_step()
+                if spec_finished is not None:
+                    return finished_pre + spec_finished
+                # no stream drafted anything: a width-1 verify would
+                # commit ONE token per dispatch — plain multistep decode
+                # commits chunk_len. Fall through to it (counted), so
+                # the drafterless worst case stays AT decode throughput,
+                # never below it.
+                self.sched.note_spec_undrafted()
+            else:
+                # a non-greedy request in the batch: speculative
+                # acceptance is only exact for greedy, so this dispatch
+                # runs the normal decode path (counted — a quiet
+                # fallback would read as a silent speedup regression)
+                self.sched.note_spec_fallback()
         new_inflight = None
         if self._active and self._need_dispatch():
             active_mask = np.zeros((self.max_batch,), bool)
@@ -441,15 +540,7 @@ class LLMEngine:
                     self._inflight["next"], jnp.asarray(self._tokens),
                     jnp.asarray(self._fresh))
             self._fresh[:] = False
-            tab = self.paged.tables
-            if self._chunked:
-                # mid-prefill slots are NOT decode-active, but their table
-                # rows are live: zero them for this dispatch so the idle
-                # scatter (len pinned 0) lands in the scratch block, never
-                # in a half-prefilled prompt block
-                tab = tab.copy()
-                for s in self._chunked:
-                    tab[s] = 0
+            tab = self._dispatch_tables()
             chunk_len = self.sched.decode_chunk_len(
                 self._min_deterministic_remaining(),
                 pressure=bool(self._waiting))
@@ -477,7 +568,7 @@ class LLMEngine:
             # synchronous mode: flush immediately (no overlap, no lag)
             flush, self._inflight = self._inflight, None
             finished += self._process_chunk(flush)
-        return finished
+        return finished_pre + finished
 
     def _need_dispatch(self) -> bool:
         """Skip the next dispatch when the in-flight chunk already covers
@@ -518,6 +609,43 @@ class LLMEngine:
             rem = r if rem is None else min(rem, r)
         return rem
 
+    def _commit_token(self, req, slot: int, tok: int, lp: float) -> bool:
+        """Append ONE committed token and report whether it finishes the
+        request (eos / stop ids / max_tokens / max_seq) — the single
+        stop-semantics implementation shared by the decode read-back,
+        the speculative commit loop and admission, so the paths can
+        never drift on what ends a generation."""
+        req.generated.append(tok)
+        req.logprobs.append(lp)
+        self.generated_tokens += 1
+        self._tokens[slot] = tok
+        eos = req.sampling.eos_id
+        return ((eos is not None and tok == eos)
+                or tok in req.sampling.stop_token_ids
+                or len(req.generated) >= req.sampling.max_tokens
+                or len(req.prompt) + len(req.generated) >= self.max_seq)
+
+    def _retire(self, req, slot: int) -> None:
+        """Finish a request and free its slot (guarded: the slot may
+        already host a newer request when retiring from a stale
+        dispatch snapshot)."""
+        req.done = True
+        if self._active.get(slot) is req:
+            del self._active[slot]
+            self.paged.release(slot)
+            self._free.append(slot)
+
+    def _dispatch_tables(self):
+        """Block tables for a decode/verify dispatch: mid-prefill slots'
+        rows zeroed so their idle scatter lands in the scratch block,
+        never a half-prefilled prompt block."""
+        tab = self.paged.tables
+        if self._chunked:
+            tab = tab.copy()
+            for s in self._chunked:
+                tab[s] = 0
+        return tab
+
     def _process_chunk(self, inflight: dict) -> list[GenRequest]:
         toks = np.asarray(inflight["toks"])     # [chunk, B] (blocks here)
         lps = np.asarray(inflight["lps"])
@@ -526,27 +654,99 @@ class LLMEngine:
         for slot, req in inflight["snapshot"]:
             if req.done:
                 continue               # aborted/retired after dispatch
-            eos = req.sampling.eos_id
-            stop_ids = req.sampling.stop_token_ids
             for t in range(toks.shape[0]):
-                tok = int(toks[t, slot])
-                req.generated.append(tok)
-                req.logprobs.append(float(lps[t, slot]))
-                self.generated_tokens += 1
-                self._tokens[slot] = tok
-                if (eos is not None and tok == eos) or tok in stop_ids or \
-                        len(req.generated) >= req.sampling.max_tokens or \
-                        len(req.prompt) + len(req.generated) >= self.max_seq:
+                if self._commit_token(req, slot, int(toks[t, slot]),
+                                      float(lps[t, slot])):
                     # overshoot tokens beyond this point are trimmed (never
                     # appended); their cache writes went to this slot's own
                     # blocks / scratch and are ordered before any reuse
-                    req.done = True
                     finished.append(req)
-                    if self._active.get(slot) is req:
-                        del self._active[slot]
-                        self.paged.release(slot)
-                        self._free.append(slot)
+                    self._retire(req, slot)
                     break
+        return finished
+
+    def _spec_step(self) -> list[GenRequest]:
+        """One speculative draft+verify round over the active batch.
+
+        The drafter proposes up to spec_k tokens per stream from its own
+        committed context; ONE verify dispatch writes all candidate KV
+        rows (tail rows masked to scratch exactly like mid-prefill pad
+        rows) and returns the target's greedy chain + logprobs; the
+        longest draft prefix matching that chain commits, plus the
+        target's own next token — so every round commits >= 1 token and
+        greedy output is token-identical to plain decode. cache["len"]
+        advances host-side by the COMMITTED count only: rejected rows
+        sit beyond it, invisible to attention, and the next dispatch
+        rewrites them before they could ever be unmasked."""
+        bs = self.paged.block_size
+        drafts: dict[int, list[int]] = {}
+        k_max = 0
+        for slot, req in self._active.items():
+            # deterministic remaining budget: drafts past it can never
+            # commit (the commit loop stops at max_tokens/max_seq), so
+            # they would only widen the verify batch for nothing
+            rem = min(req.sampling.max_tokens - len(req.generated),
+                      self.max_seq - len(req.prompt) - len(req.generated))
+            d = self.spec.draft(req.prompt + req.generated)[:max(0, rem - 1)]
+            drafts[slot] = d
+            k_max = max(k_max, len(d))
+        if k_max == 0:
+            return None           # nothing to verify: caller runs decode
+        # pow2 verify width (input column + drafts): log2(spec_k+1)
+        # compile variants, the scheduler's static chunk_len scheme
+        width = ceil_pow2(1 + k_max)
+        tokens = np.zeros((self.max_batch, width), np.int32)
+        limit = np.zeros((self.max_batch,), np.int32)
+        for slot, req in self._active.items():
+            tokens[slot, 0] = self._tokens[slot]
+            d = drafts[slot]
+            tokens[slot, 1:1 + len(d)] = d
+            # rows at/after the slot's reserved tokens scatter to scratch
+            limit[slot] = len(self.paged.slot_blocks(slot)) * bs
+        self.sched.note_spec_dispatch(
+            sum(len(d) for d in drafts.values()))
+        toks, lps, self.cache = self._verify(
+            self.params, jnp.asarray(tokens), self.cache,
+            jnp.asarray(self._dispatch_tables()), jnp.asarray(limit))
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        self.steps += 1
+        finished = []
+        new_len = np.zeros((self.max_batch,), np.int32)
+        for slot, req in list(self._active.items()):
+            if req.done:
+                continue               # aborted after dispatch
+            d = drafts[slot]
+            # acceptance: walk the target's greedy chain; position i's
+            # token commits, and matching draft i validates position i+1
+            accepted = 0
+            committed: list[tuple[int, float]] = []
+            for i in range(len(d) + 1):
+                committed.append((int(toks[slot, i]),
+                                  float(lps[slot, i])))
+                if i < len(d) and d[i] == committed[-1][0]:
+                    accepted += 1
+                    continue
+                break
+            n_appended = 0
+            done = False
+            for tok, lp in committed:
+                n_appended += 1
+                if self._commit_token(req, slot, tok, lp):
+                    done = True
+                    break
+            # count only draft tokens that actually COMMITTED: an early
+            # stop (eos/budget) truncates acceptance too, or the counter
+            # would overstate the drafter on eos-heavy traffic
+            self.sched.note_spec_result(min(accepted, n_appended),
+                                        n_appended)
+            if done:
+                finished.append(req)
+                self._retire(req, slot)
+            else:
+                # committed length only — rejected rows stay beyond it
+                new_len[slot] = len(req.prompt) + len(req.generated) - 1
+        self.cache = self._set_lens(self.cache, jnp.asarray(new_len))
         return finished
 
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -771,19 +971,10 @@ class LLMEngine:
                     first_lp: float) -> None:
         """Per-request bookkeeping after its KV is resident: the
         prefill-sampled token is generation token #1; decode continues
-        from it (or the request finishes instantly on eos/budget)."""
-        req.generated.append(first_tok)
-        req.logprobs.append(first_lp)
-        self.generated_tokens += 1
+        from it (or the request finishes instantly on eos/budget —
+        the same _commit_token stop semantics as every other path)."""
         req.slot = slot
-        self._tokens[slot] = first_tok
         self._fresh[slot] = True       # override any device token carry
         self._active[slot] = req
-        eos = req.sampling.eos_id
-        if (eos is not None and first_tok == eos) or \
-                first_tok in req.sampling.stop_token_ids or \
-                req.sampling.max_tokens <= 1:
-            req.done = True
-            del self._active[slot]
-            self.paged.release(slot)
-            self._free.append(slot)
+        if self._commit_token(req, slot, first_tok, first_lp):
+            self._retire(req, slot)

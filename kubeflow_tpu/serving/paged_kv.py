@@ -431,23 +431,55 @@ def _resolve_decode_kernel(kernel: str) -> str:
     "auto": pallas on TPU, gather elsewhere. An explicit "pallas" request
     holds on TPU and CPU (interpret mode); other platforms (gpu) fall
     back to gather, mirroring ops/attention.py's impl dispatch."""
+    return resolve_decode_kernel(kernel)[0]
+
+
+def resolve_decode_kernel(kernel: str, mesh=None,
+                          n_kv_heads: Optional[int] = None,
+                          platform: Optional[str] = None):
+    """Full kernel resolution -> (resolved, downgrade_reason).
+
+    "auto": pallas on TPU — INCLUDING under a mesh, via the shard_map'd
+    kernel (paged_decode_attention_sharded) — gather elsewhere. An
+    explicit "pallas" holds on TPU and CPU (interpret mode). A downgrade
+    the caller did not ask for (gpu platform, or a mesh topology the
+    shard_map wrapper can't partition) returns the reason so the engine
+    can COUNT and log it (kft_model_kernel_downgrades_total) instead of
+    silently losing the block-resident path's bandwidth."""
+    from kubeflow_tpu.ops.pallas_paged_attention import (
+        shard_unsupported_reason,
+    )
+
     if kernel not in ("auto", "pallas", "gather"):
         raise ValueError(f"kernel={kernel!r} (want auto|pallas|gather)")
-    platform = jax.default_backend()
+    platform = platform or jax.default_backend()
+    if kernel == "gather":
+        return "gather", None
     if kernel == "auto":
-        return "pallas" if platform == "tpu" else "gather"
-    if kernel == "pallas" and platform not in ("tpu", "cpu"):
-        return "gather"
-    return kernel
+        resolved = "pallas" if platform == "tpu" else "gather"
+    else:
+        if platform not in ("tpu", "cpu"):
+            return "gather", (f"kernel='pallas' has no {platform} path "
+                              "(mosaic is TPU-only; CPU runs interpret "
+                              "mode)")
+        resolved = "pallas"
+    if resolved == "pallas" and mesh is not None:
+        reason = shard_unsupported_reason(
+            mesh, n_kv_heads if n_kv_heads is not None else 0)
+        if reason is not None:
+            return "gather", reason
+    return resolved, None
 
 
 def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
-                      kernel: str = "gather"):
+                      kernel: str = "gather", mesh=None):
     """One decode step over the paged pool. token: [B] int32; tables:
     [B, max_blocks_per_seq] int32 -> (logits [B, V], cache). ``kernel``
     picks the attention path (module docstring): "gather" | "pallas" |
-    "auto"."""
-    kernel = _resolve_decode_kernel(kernel)
+    "auto"; with ``mesh`` the pallas path runs shard_map'd over the
+    heads/KV tensor axis (per-shard pool blocks, replicated tables)."""
+    kernel, _ = resolve_decode_kernel(kernel, mesh=mesh,
+                                      n_kv_heads=cfg.n_kv_heads)
     interpret = jax.default_backend() == "cpu"
     b = token.shape[0]
     bs = cache["k"].shape[2]
@@ -471,14 +503,21 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
         v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
         if kernel == "pallas":
             # block-resident kernel: per slot, only the live blocks named
-            # by its table row move HBM->VMEM; no [max_seq] view exists
+            # by its table row move HBM->VMEM; no [max_seq] view exists.
+            # Under a mesh the call shard_maps over the heads/KV axis —
+            # per-shard pool blocks, replicated tables, no collectives.
             from kubeflow_tpu.ops.pallas_paged_attention import (
-                paged_decode_attention,
+                paged_decode_attention, paged_decode_attention_sharded,
             )
 
-            o = paged_decode_attention(
-                q[:, 0], k_pool, v_pool, tables, pos + 1,
-                interpret=interpret)[:, None]
+            if mesh is not None:
+                o = paged_decode_attention_sharded(
+                    q[:, 0], k_pool, v_pool, tables, pos + 1,
+                    mesh=mesh, interpret=interpret)[:, None]
+            else:
+                o = paged_decode_attention(
+                    q[:, 0], k_pool, v_pool, tables, pos + 1,
+                    interpret=interpret)[:, None]
         else:
             # gather each slot's logical view: block j of slot b holds
             # logical positions [j*bs, (j+1)*bs) — table order IS
@@ -555,3 +594,71 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
     last_row = jnp.clip(length - offset - 1, 0, c - 1)
     return x[:, last_row], {"k": new_k, "v": new_v, "len": cache["len"]}
+
+
+def paged_verify_step(params, tokens, cfg: llama.LlamaConfig, cache,
+                      tables, limit):
+    """Batched multi-token target step for speculative decoding: ONE
+    dispatch scores ``S`` candidate positions per slot (vLLM/Medusa
+    verify role). tokens: [B, S] int32 where column 0 is the slot's last
+    committed token and columns 1.. are drafter proposals; row s of slot
+    b lands at position ``cache['len'][b] + s`` (the same "input token's
+    KV is written this step" convention the decode step uses), and
+    logits[b, s] predicts position len+s+1. limit: [B] int32 — tokens
+    the slot's reserved blocks can hold; rows at/after it (a draft tail
+    running past the allocation, or an idle/mid-prefill slot with
+    limit 0) scatter to the scratch block exactly like mid-prefill pad
+    rows, never into live data.
+
+    Rejected-tail KV rows need no cleanup: the NEXT dispatch (verify or
+    plain decode) starts at the committed length and rewrites every
+    rejected position before attention can see it — its queries attend
+    kv positions <= their own, and all its writes cover [len, len+S).
+    cache["len"] is NOT advanced here; the engine commits the accepted
+    length host-side after comparing drafts against the argmax chain.
+
+    Attention uses the gather view with per-slot causal offsets (the
+    only multi-query-row path; S is tiny, so this step is compute-
+    shaped like a short prefill, not the bandwidth-bound single-row
+    decode the pallas kernel exists for) — under a mesh XLA
+    auto-partitions it like the chunked-prefill program.
+
+    Returns (logits [B, S, V] f32, cache)."""
+    b, s = tokens.shape
+    bs = cache["k"].shape[2]
+    inv_freq = jnp.asarray(rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        original_max_seq=cfg.max_seq,
+    ))
+    start = cache["len"]                                   # [B]
+    pos = start[:, None] + jnp.arange(s)[None, :]          # [B, S] absolute
+    valid = pos < limit[:, None]
+    batch = jnp.arange(b)
+    blk = jnp.where(
+        valid,
+        tables[batch[:, None],
+               jnp.clip(pos // bs, 0, tables.shape[1] - 1)],
+        0)
+    off = pos % bs
+    x = params["embed"].astype(cfg.dtype)[tokens]          # [B, S, D]
+
+    from kubeflow_tpu.ops.attention import _xla_attention
+
+    def block_fn(x, xs):
+        lp, k_pool, v_pool = xs
+        q, k, v = _layer_qkv(lp, x, pos, cfg, inv_freq)
+        k_pool = k_pool.at[blk, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v.astype(v_pool.dtype))
+        k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
+        v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
+        # per-slot query offsets: row s (position start[b]+s) attends kv
+        # rows <= start[b]+s — this step's own earlier rows included,
+        # every stale/rejected row beyond them masked
+        o = _xla_attention(q, k_view, v_view, causal=True, q_offset=start)
+        return _layer_out(lp, x, o, cfg, token_mask=valid), (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["layers"], cache["k"], cache["v"]))
+    d = x.shape[-1]
+    logits = _lm_head(params, x.reshape(b * s, d), cfg).reshape(b, s, -1)
+    return logits, {"k": new_k, "v": new_v, "len": cache["len"]}

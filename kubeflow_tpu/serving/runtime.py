@@ -32,6 +32,12 @@ Env contract (all optional except the uri for real weights):
   KFT_ADAPTIVE_DECODE_CHUNK  "0" disables decode-chunk trimming under
                              queue pressure
   KFT_RADIX_CACHE            "0" disables radix prefix-cache sharing
+  KFT_SPEC_DECODE            "1" enables speculative decoding (draft +
+                             one batched verify step; greedy outputs
+                             token-identical to plain decode)
+  KFT_SPEC_K                 max draft tokens per verify step (default 4)
+  KFT_SPEC_DRAFTER           drafter name (default "ngram" =
+                             prompt-lookup, zero extra weights)
 """
 
 from __future__ import annotations
@@ -60,20 +66,29 @@ def init_storage(env: Mapping[str, str]) -> Optional[str]:
 
 def scheduler_from_env(env: Mapping[str, str]):
     """KFT_PREFILL_QUOTA / KFT_INTERLEAVE_PREFILL /
-    KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE -> SchedulerConfig (None
-    when nothing is set, keeping the engine defaults)."""
+    KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE / KFT_SPEC_DECODE /
+    KFT_SPEC_K / KFT_SPEC_DRAFTER -> SchedulerConfig (None when nothing
+    is set, keeping the engine defaults)."""
     from kubeflow_tpu.serving.scheduler import SchedulerConfig
 
     keys = ("KFT_PREFILL_QUOTA", "KFT_INTERLEAVE_PREFILL",
-            "KFT_ADAPTIVE_DECODE_CHUNK", "KFT_RADIX_CACHE")
+            "KFT_ADAPTIVE_DECODE_CHUNK", "KFT_RADIX_CACHE",
+            "KFT_SPEC_DECODE", "KFT_SPEC_K", "KFT_SPEC_DRAFTER")
     if not any(env.get(k) for k in keys):
         return None
     on = lambda k: env.get(k, "1") not in ("0", "false", "no", "")
+    defaults = SchedulerConfig()
     return SchedulerConfig(
         prefill_tokens_per_step=int(env.get("KFT_PREFILL_QUOTA", "0") or 0),
         interleave_prefill=on("KFT_INTERLEAVE_PREFILL"),
         adaptive_decode_chunk=on("KFT_ADAPTIVE_DECODE_CHUNK"),
-        radix_cache=on("KFT_RADIX_CACHE"))
+        radix_cache=on("KFT_RADIX_CACHE"),
+        # spec decode is opt-in: unset reads as the config default (off)
+        spec_decode=env.get("KFT_SPEC_DECODE", "") not in
+            ("", "0", "false", "no"),
+        spec_k=int(env.get("KFT_SPEC_K", "") or defaults.spec_k),
+        spec_drafter=env.get("KFT_SPEC_DRAFTER", "")
+            or defaults.spec_drafter)
 
 
 def build_model_from_env(env: Mapping[str, str]) -> Model:

@@ -51,12 +51,28 @@ class SchedulerConfig:
         freed slots rejoin early. False = fixed decode_chunk dispatches.
     radix_cache: share prompt KV blocks through the radix prefix tree
         (PagedKV). False disables matching AND publishing.
+    spec_decode: speculative decoding — a host-side drafter
+        (serving/spec_decode.py) proposes up to spec_k tokens per
+        stream, the target model verifies them in ONE batched step, and
+        the accepted prefix commits (greedy outputs token-identical to
+        non-speculative decode; a dispatch with a non-greedy request in
+        the batch falls back to normal decode, counted).
+    spec_k: max draft tokens per verify step. The verify width
+        (1 + spec_k: the input column plus drafts) pads to the next
+        power of two, so the compile count stays log2 — the same static
+        pow2 chunk_len scheme the adaptive decode chunk uses; the
+        default 3 makes the full width exactly 4.
+    spec_drafter: drafter name ("ngram" = prompt-lookup, zero extra
+        weights).
     """
 
     prefill_tokens_per_step: int = 0
     interleave_prefill: bool = True
     adaptive_decode_chunk: bool = True
     radix_cache: bool = True
+    spec_decode: bool = False
+    spec_k: int = 3
+    spec_drafter: str = "ngram"
 
 
 def ceil_pow2(n: int) -> int:
@@ -84,6 +100,14 @@ class StepScheduler:
         self.preempts = 0                  # chunked prefills cancelled mid-flight
         self.admission_stalls = 0          # reservation failed under pressure
         self.short_chunks = 0              # adaptive trims under pressure
+        # speculative decoding (spec_decode=True dispatches)
+        self.spec_dispatches = 0           # verify steps dispatched
+        self.spec_slot_rounds = 0          # (dispatch, live stream) pairs
+        self.spec_draft_tokens = 0         # drafter proposals scored
+        self.spec_accepted_draft_tokens = 0  # proposals matching target
+        self.spec_committed_tokens = 0     # tokens committed by verifies
+        self.spec_fallbacks = 0            # non-greedy batch -> plain decode
+        self.spec_undrafted = 0            # no drafts anywhere -> plain decode
 
     # ---- per-step decisions ----
 
@@ -137,6 +161,21 @@ class StepScheduler:
     def note_stall(self) -> None:
         self.admission_stalls += 1
 
+    def note_spec_dispatch(self, drafted: int) -> None:
+        self.spec_dispatches += 1
+        self.spec_draft_tokens += int(drafted)
+
+    def note_spec_result(self, accepted: int, committed: int) -> None:
+        self.spec_slot_rounds += 1
+        self.spec_accepted_draft_tokens += int(accepted)
+        self.spec_committed_tokens += int(committed)
+
+    def note_spec_fallback(self) -> None:
+        self.spec_fallbacks += 1
+
+    def note_spec_undrafted(self) -> None:
+        self.spec_undrafted += 1
+
     # ---- export ----
 
     def snapshot(self, *, active: int, waiting: int, chunked: int,
@@ -166,4 +205,18 @@ class StepScheduler:
             "prefix_hit_blocks_total": prefix_hits,
             "prefix_query_blocks_total": prefix_queries,
             "prefix_hit_rate": round(rate, 4),
+            # speculative decoding: accepted_tokens_per_step is PER
+            # STREAM per verify step — the tokens/s/stream speedup lever
+            # (1.0 = plain decode; the acceptance floor, never below)
+            "spec_dispatches_total": self.spec_dispatches,
+            "spec_slot_rounds_total": self.spec_slot_rounds,
+            "spec_draft_tokens_total": self.spec_draft_tokens,
+            "spec_accepted_draft_tokens_total":
+                self.spec_accepted_draft_tokens,
+            "spec_committed_tokens_total": self.spec_committed_tokens,
+            "spec_fallbacks_total": self.spec_fallbacks,
+            "spec_undrafted_steps_total": self.spec_undrafted,
+            "accepted_tokens_per_step": round(
+                self.spec_committed_tokens / self.spec_slot_rounds, 4)
+                if self.spec_slot_rounds else 0.0,
         }

@@ -17,7 +17,8 @@ from kubeflow_tpu.api.types import TPUSpec
 # The predictor-spec view of the continuous-batching step scheduler
 # (serving/scheduler.py is pure stdlib, so the control plane can carry it
 # without importing jax): per-step prefill token quota, chunked-prefill
-# interleaving, adaptive decode-chunk trims, radix prefix cache.
+# interleaving, adaptive decode-chunk trims, radix prefix cache, and the
+# speculative-decoding knobs (spec_decode / spec_k / spec_drafter).
 from kubeflow_tpu.serving.scheduler import SchedulerConfig as SchedulerPolicy
 
 
@@ -68,7 +69,8 @@ class PredictorSpec:
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     # LLM runtimes only: step-scheduler knobs, stamped onto the predictor
     # pod as KFT_PREFILL_QUOTA / KFT_INTERLEAVE_PREFILL /
-    # KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE by the ISVC controller
+    # KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE / KFT_SPEC_DECODE /
+    # KFT_SPEC_K / KFT_SPEC_DRAFTER by the ISVC controller
     scheduler: Optional[SchedulerPolicy] = None
 
 
