@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -120,6 +120,40 @@ test-spec-decode:
 			+ str(e['accepted_tokens_per_step']) \
 			+ ' device_step_speedup=' + str(e['device_step_speedup']) \
 			+ ' e2e_speedup=' + str(e['spec_decode_speedup']))"
+
+# multi-replica serving fleet e2e (ISSUE 12): the fleet unit suite
+# (ring stability, bounded-load spill, sticky canary split, autoscaler
+# hysteresis, serving-vs-train claim race, canary rollback), then the
+# fleet bench smoke. Two independent teeth (like test-serving-sched):
+# bench.py exits nonzero unless >=2 replicas really served traffic, a
+# REAL warm-claim scale-up occurred, and the JSON carries per-replica
+# hit-rate + scale-latency fields; the JSON contract is then re-checked
+# from the captured file so a silently-vanished counter regresses
+# visibly.
+FLEET_SMOKE_JSON := /tmp/kft-fleet-smoke.json
+test-fleet:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-smoke > $(FLEET_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(FLEET_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; k = e['kube_fleet']; s = k['scale_up']; \
+		assert k['warm_pool']['claims'] >= 1, ('no warm claim', d); \
+		served = [p for p in k['replicas_2_affine']['per_replica'].values() \
+			if p.get('generated_tokens', 0) > 0]; \
+		assert len(served) >= 2, ('fewer than 2 replicas served', d); \
+		assert all('prefix_hit_rate' in p for p in \
+			k['replicas_2_affine']['per_replica'].values()), d; \
+		assert s['total_replica_add_seconds'] is not None, d; \
+		assert s['model_load_seconds'] is not None, d; \
+		assert s['precompile_seconds'] is not None, d; \
+		assert s['depot_outcome'] is not None, d; \
+		r = e['affinity_sweep']['hit_rate_vs_baseline_2_replicas']; \
+		assert r['affine'] >= 0.85, ('affine hit rate diluted', r); \
+		assert k['canary']['decision'] == 'promote', d; \
+		print('fleet bench OK: scale_up=' + json.dumps(s['depot_outcome']) \
+			+ ' add_s=' + str(s['total_replica_add_seconds']) \
+			+ ' affine_vs_baseline=' + str(r['affine']) \
+			+ ' random_diluted=' + str(r['random_diluted']))"
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -388,6 +388,11 @@ def _serving_bench(dev, on_tpu: bool) -> dict:
     # system-prompt workload — accepted_tokens_per_step and the
     # spec-vs-baseline tokens/s/stream ratio, token-identity asserted
     out["spec_decode"] = _spec_decode_bench(params, cfg, on_tpu)
+    # ISSUE 12 tentpole (b): prefix-affine fleet routing — per-replica
+    # radix hit rate preserved under consistent-hash routing vs the
+    # measured dilution under random routing (the kube fleet bench in
+    # `--fleet-smoke` adds real multi-process replicas + warm scale-up)
+    out["fleet_affinity"] = _fleet_affinity_sweep(params, cfg, on_tpu)
     return out
 
 
@@ -628,6 +633,133 @@ def _requests_per_sec_sweep(params, cfg, on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _fleet_affinity_sweep(params, cfg, on_tpu: bool) -> dict:
+    """Multi-replica routing-policy sweep, in process: N LLMEngine
+    replicas behind the FleetRouter, a multi-tenant shared-prefix
+    workload (T tenants x S streams each — the fleet analogue of the
+    shared-system-prompt sweep), prefix-AFFINE consistent-hash routing
+    vs the random-routing ablation. The acceptance number is per-replica
+    prefix-hit rate: affine routing must hold it at the single-replica
+    baseline while random routing dilutes it ~N ways (each replica pays
+    its own cold miss per tenant).
+
+    Replicas share one device here, so requests_per_sec across N is a
+    routing/overhead measurement, not a capacity one — real capacity
+    scaling is measured by the multi-process kube fleet bench
+    (``--fleet-smoke``), where each replica is its own pod."""
+    import numpy as np
+
+    from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+    from kubeflow_tpu.serving.router import FleetRouter
+    from kubeflow_tpu.serving.scheduler import SchedulerConfig
+
+    if on_tpu:
+        tenants, per_tenant, max_batch, block = 16, 8, 32, 16
+        sys_len, tail_len, max_tokens = 96, 32, 32
+        counts = (1, 2)
+    else:
+        tenants, per_tenant, max_batch, block = 16, 8, 8, 8
+        sys_len, tail_len, max_tokens = 16, 8, 4
+        counts = (1, 2, 4)
+    prompt_len = sys_len + tail_len
+    arena = -(-(prompt_len + max_tokens + block) // block) * block
+    try:
+        rng = np.random.default_rng(11)
+        sp = SamplingParams(max_tokens=max_tokens)
+        systems = [rng.integers(1, cfg.vocab_size, sys_len).tolist()
+                   for _ in range(tenants)]
+        prompts = [s + rng.integers(1, cfg.vocab_size, tail_len).tolist()
+                   for s in systems for _ in range(per_tenant)]
+        warm_sys = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+
+        def run(n: int, policy: str) -> dict:
+            engines = [LLMEngine(params, cfg, max_batch=max_batch,
+                                 max_seq=arena,
+                                 prefill_buckets=(prompt_len,),
+                                 kv_block_size=block,
+                                 scheduler=SchedulerConfig())
+                       for _ in range(n)]
+            for eng in engines:       # warm compiles outside the window
+                eng.generate([warm_sys + rng.integers(
+                    1, cfg.vocab_size, tail_len).tolist()
+                    for _ in range(max_batch)], SamplingParams(max_tokens=2))
+            names = [f"replica-{i}" for i in range(n)]
+            router = FleetRouter(block_size=block, policy=policy,
+                                 spill_queue_depth=2 * max_batch)
+            for name, eng in zip(names, engines):
+                router.add_replica(name, eng)
+            base = [(e.paged.prefix_hits, e.paged.prefix_queries)
+                    for e in engines]
+            t0 = time.perf_counter()
+            reqs = []
+            for i, p in enumerate(prompts):
+                eng = engines[names.index(router.pick(p, request_id=i))]
+                reqs.append(eng.add_request(p, sp))
+            while any(e.has_work() for e in engines):
+                for e in engines:
+                    if e.has_work():
+                        e.step()
+            dt = time.perf_counter() - t0
+            assert all(r.done for r in reqs)
+            per_replica = {}
+            rates = []
+            for name, eng, (h0, q0) in zip(names, engines, base):
+                h = eng.paged.prefix_hits - h0
+                q = eng.paged.prefix_queries - q0
+                entry = {"streams": router.routes_by_replica.get(name, 0),
+                         "prefix_hit_blocks": h, "prefix_query_blocks": q}
+                if q:
+                    entry["prefix_hit_rate"] = round(h / q, 4)
+                    rates.append(h / q)
+                per_replica[name] = entry
+            out = {
+                "replicas": n, "policy": policy,
+                "requests_per_sec": round(len(prompts) / dt, 2),
+                "per_replica": per_replica,
+                "fleet_prefix_hit_rate": round(
+                    sum(p["prefix_hit_blocks"] for p in per_replica.values())
+                    / max(1, sum(p["prefix_query_blocks"]
+                                 for p in per_replica.values())), 4),
+                "mean_per_replica_hit_rate": round(
+                    sum(rates) / len(rates), 4) if rates else 0.0,
+                "router": router.snapshot(),
+            }
+            return out
+
+        sweep = {"1": run(1, "affine")}
+        for n in counts[1:]:
+            sweep[str(n)] = {"affine": run(n, "affine"),
+                             "random": run(n, "random")}
+        baseline = sweep["1"]["fleet_prefix_hit_rate"]
+        result = {
+            "workload": {"tenants": tenants, "streams_per_tenant": per_tenant,
+                         "streams": len(prompts),
+                         "shared_prefix_tokens": sys_len,
+                         "prompt_len": prompt_len, "max_tokens": max_tokens,
+                         "kv_block_size": block,
+                         "slots_per_replica": max_batch},
+            "single_replica_prefix_hit_rate": baseline,
+            "sweep": sweep,
+            "note": ("replicas share one device in-process: "
+                     "requests_per_sec here isolates routing policy; "
+                     "capacity scaling is the multi-process kube fleet "
+                     "bench (--fleet-smoke)"),
+        }
+        # the acceptance comparison, stated directly: affine holds the
+        # per-replica hit rate at baseline, random dilutes it
+        for n in counts[1:]:
+            aff = sweep[str(n)]["affine"]["mean_per_replica_hit_rate"]
+            rnd = sweep[str(n)]["random"]["mean_per_replica_hit_rate"]
+            result[f"hit_rate_vs_baseline_{n}_replicas"] = {
+                "affine": round(aff / baseline, 4) if baseline else None,
+                "random_diluted": round(rnd / baseline, 4)
+                if baseline else None,
+            }
+        return result
+    except Exception as e:                    # never sink the bench line
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _decode_path_times(eng, live_len: int,
                        kernels=("pallas", "gather")) -> dict:
     """Best-of ms/step for each decode-attention path of ``eng`` over a
@@ -670,6 +802,461 @@ def _decode_path_times(eng, live_len: int,
                        / (n * eng.decode_chunk))
         out[kern] = round(best * 1000, 3)
     return out
+
+
+def _fleet_kube_bench() -> dict:
+    """The multi-replica serving fleet, end to end on the kube backend:
+    fake apiserver + image-less kubelet run REAL predictor processes, the
+    ServingTicker autoscales on scraped ``kft_model_sched_*`` signals
+    (queue depth / occupancy / token backlog), and the scale-up replica
+    is CLAIMED from the warm pool — forked from a pre-imported zygote
+    with the decode executable depot-prefetched at claim time — so
+    replica add is bounded by warm-claim + model-load + depot-fetch, not
+    a cold interpreter + compile. Phases:
+
+      1. cold replica #1 (pool dry: counted fallback) — publishes the
+         decode executable to the depot and warms the XLA disk cache;
+      2. traffic at 1 replica (requests_per_sec baseline + per-replica
+         prefix-hit rate on the multi-tenant shared-prefix workload);
+      3. a queue burst drives the autoscaler to 2: the new pod claims
+         the warm standby (decomposed: signal->claim, claim->ready,
+         in-replica model_load / precompile seconds, depot outcome);
+      4. traffic at 2 replicas, prefix-AFFINE vs random routing
+         (per-replica hit-rate preservation vs measured dilution);
+      5. canary rollout: a new revision at 50% traffic, sticky split by
+         request id, promoted through ServingController.promote once the
+         CanaryGate's error-rate SLO holds.
+    """
+    import collections
+    import json as _json
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from kubeflow_tpu.controller import (
+        FakeKubeApiServer, FakeKubelet, KubeCluster, WarmPoolController,
+    )
+    from kubeflow_tpu.models import hf_llama, llama
+    from kubeflow_tpu.serving.controller import (
+        Autoscaler, RuntimeRegistry, ServingController, ServingTicker,
+    )
+    from kubeflow_tpu.serving.router import FleetRouter, TrafficSplitter
+    from kubeflow_tpu.serving.types import (
+        CanarySLO, InferenceService, ModelFormat, PredictorSpec,
+        ServingRuntime,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="kft-fleet-")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ns, svc = "default", "fleetllm"
+    max_batch, max_seq = 8, 128
+    # max_tokens 32: enough decode work per request that the traffic
+    # phases measure replica CAPACITY (tiny-model HTTP round trips are
+    # otherwise over before the second replica matters)
+    sys_len, tail_len, max_tokens = 64, 8, 32
+    tenants, per_tenant = 8, 8
+    srv = kubelet = None
+    stop = threading.Event()
+
+    def cleanup():
+        stop.set()
+        try:
+            if kubelet is not None:
+                kubelet.stop()
+        finally:
+            if srv is not None:
+                srv.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    try:
+        import dataclasses as _dc
+
+        import jax.numpy as _jnp
+
+        cfg = llama.llama_tiny(dtype=_jnp.float32)
+        ckpt = os.path.join(tmp, "ckpt")
+        hf_llama.save_pretrained(
+            ckpt, cfg, llama.init_params(jax.random.key(0), cfg))
+
+        base_env = {
+            "PYTHONPATH": repo + ":" + os.environ.get("PYTHONPATH", ""),
+            "KFT_FORCE_PLATFORM": "cpu",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        }
+        srv = FakeKubeApiServer().start()
+        kube = KubeCluster(srv.url, host_ports=True)
+        pool = WarmPoolController(
+            kube, size=0, reap_s=600.0, env=dict(base_env),
+            command=[sys.executable, "-m",
+                     "kubeflow_tpu.rendezvous.zygote", "tcp://127.0.0.1:0"])
+        kube.warm_pool = pool
+        registry = RuntimeRegistry()
+        registry.register(ServingRuntime(
+            name="kft-llama", supported_formats=[ModelFormat("llama")],
+            command=[sys.executable, "-m", "kubeflow_tpu.serving.runtime"]))
+        ctl = ServingController(kube, registry)
+        scaler = Autoscaler(idle_grace_seconds=600.0,
+                            backlog_tokens_per_replica=4096)
+        ticker = ServingTicker(ctl, scaler)
+        kubelet = FakeKubelet(srv.url, log_dir=os.path.join(tmp, "pods"))
+        kubelet.start()
+
+        def tick_loop():
+            while not stop.wait(0.3):
+                try:
+                    pool.reconcile()
+                    ticker.tick()
+                except Exception:
+                    pass
+        threading.Thread(target=tick_loop, daemon=True,
+                         name="fleet-tick").start()
+
+        isvc = InferenceService(name=svc, namespace=ns, predictor=PredictorSpec(
+            model_format=ModelFormat("llama"),
+            min_replicas=1, max_replicas=2, scale_metric="sched",
+            scale_target=max_batch,
+            env={**base_env,
+                 "KFT_MODEL_DIR": ckpt, "KFT_DTYPE": "float32",
+                 "KFT_MAX_BATCH": str(max_batch),
+                 "KFT_MAX_SEQ": str(max_seq),
+                 "KFT_COMPILE_CACHE": os.path.join(tmp, "xla-cache"),
+                 "KFT_DEPOT": os.path.join(tmp, "depot"),
+                 "KFT_DEPOT_CACHE": os.path.join(tmp, "depot-cache")}))
+
+        def predictor_pods(revision=None):
+            sel = {"isvc": svc, "component": "predictor"}
+            if revision is not None:
+                sel["revision"] = str(revision)
+            return [p for p in kube.list_pods(ns, sel)
+                    if p is not None and p.env.get("KFT_BIND")]
+
+        def wait_ready(n, revision=None, timeout_s=240.0):
+            """n replicas answering /v2/health/ready."""
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                live = []
+                for p in predictor_pods(revision):
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://{p.env['KFT_BIND']}/v2/health/ready",
+                                timeout=1.0) as r:
+                            if _json.loads(r.read()).get("ready"):
+                                live.append(p)
+                    except Exception:
+                        continue
+                if len(live) >= n:
+                    return live
+                time.sleep(0.2)
+            detail = ", ".join(f"{p.name}:{p.phase}"
+                               for p in predictor_pods())
+            logs = "; ".join(
+                f"{p.name}: ...{kubelet.pod_log(p.namespace, p.name)[-300:]}"
+                for p in predictor_pods())
+            raise TimeoutError(
+                f"{n} ready replicas (rev {revision}) not up in "
+                f"{timeout_s}s; pods: {detail}; logs: {logs}")
+
+        def replica_stats(pod):
+            with urllib.request.urlopen(
+                    f"http://{pod.env['KFT_BIND']}/v2/models/{svc}/stats",
+                    timeout=5.0) as r:
+                return _json.loads(r.read())
+
+        def predict(pod, prompt, n_tokens=max_tokens, timeout=120.0):
+            body = _json.dumps({
+                "inputs": [{"name": "tokens", "shape": [1, len(prompt)],
+                            "datatype": "INT32", "data": [prompt]}],
+                "parameters": {"max_tokens": n_tokens, "eos_id": -1},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://{pod.env['KFT_BIND']}/v2/models/{svc}/infer",
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return _json.loads(r.read())
+
+        def tenant_prompts(seed):
+            r2 = np.random.default_rng(seed)
+            systems = [r2.integers(1, cfg.vocab_size, sys_len).tolist()
+                       for _ in range(tenants)]
+            return [s + r2.integers(1, cfg.vocab_size, tail_len).tolist()
+                    for s in systems for _ in range(per_tenant)]
+
+        def drive(pods, prompts, policy, threads=8):
+            """Route every prompt through the FleetRouter onto real
+            replica pods; returns (rps, per-replica deltas, router snap,
+            errors). Bounded load = live in-flight per replica."""
+            inflight = collections.Counter()
+            lock = threading.Lock()
+            router = FleetRouter(block_size=64, policy=policy,
+                                 spill_queue_depth=2 * max_batch,
+                                 load_of=lambda n, b: inflight[n])
+            by_name = {p.name: p for p in pods}
+            for name in by_name:
+                router.add_replica(name)
+            base = {p.name: replica_stats(p) for p in pods}
+            errors = []
+            work = list(enumerate(prompts))
+            t0 = time.perf_counter()
+
+            def worker():
+                while True:
+                    with lock:
+                        if not work:
+                            return
+                        i, prompt = work.pop(0)
+                    name = router.pick(prompt, request_id=i)
+                    with lock:
+                        inflight[name] += 1
+                    try:
+                        predict(by_name[name], prompt)
+                    except Exception as e:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    finally:
+                        with lock:
+                            inflight[name] -= 1
+
+            ts = [threading.Thread(target=worker) for _ in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            per = {}
+            rates = []
+            for p in pods:
+                now_s = replica_stats(p)
+                h = (now_s["sched"]["prefix_hit_blocks_total"]
+                     - base[p.name]["sched"]["prefix_hit_blocks_total"])
+                q = (now_s["sched"]["prefix_query_blocks_total"]
+                     - base[p.name]["sched"]["prefix_query_blocks_total"])
+                tok = (now_s["generated_tokens_total"]
+                       - base[p.name]["generated_tokens_total"])
+                per[p.name] = {"requests": router.routes_by_replica.get(
+                                   p.name, 0),
+                               "generated_tokens": tok,
+                               "prefix_hit_blocks": h,
+                               "prefix_query_blocks": q}
+                if q:
+                    per[p.name]["prefix_hit_rate"] = round(h / q, 4)
+                    rates.append(h / q)
+            return {
+                "requests": len(prompts),
+                "requests_per_sec": round(len(prompts) / dt, 2),
+                "errors": len(errors),
+                "per_replica": per,
+                "mean_per_replica_hit_rate": round(
+                    sum(rates) / len(rates), 4) if rates else 0.0,
+                "router": router.snapshot(),
+            }, errors
+
+        out = {"workload": {
+            "tenants": tenants, "streams_per_tenant": per_tenant,
+            "shared_prefix_tokens": sys_len,
+            "prompt_len": sys_len + tail_len, "max_tokens": max_tokens,
+            "slots_per_replica": max_batch}}
+
+        # ---- phase 1: cold replica #1 (publishes the depot entry) ----
+        t0 = time.time()
+        with ticker.lock:                 # apply races the tick thread
+            ctl.apply(isvc)
+        pods = wait_ready(1)
+        out["cold_replica_add_seconds"] = round(time.time() - t0, 2)
+        s0 = replica_stats(pods[0])
+        out["replica_1"] = {
+            "pod": pods[0].name,
+            "load_seconds": s0.get("load_seconds"),
+            "precompile_seconds": s0.get("precompile_seconds"),
+            "depot_outcome": s0.get("depot_outcome"),
+        }
+        # warm the pool OUTSIDE any measured window
+        pool.size = 1
+
+        def wait_warm(timeout_s=120.0):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                for cls in pool.classes:            # class key, not ns
+                    for p in pool._pool_pods(cls, "standby"):
+                        if p is not None and kubelet.wait_announced(
+                                p.namespace, p.name, timeout_s=0.2):
+                            return True
+                time.sleep(0.1)
+            return False
+
+        if not wait_warm():
+            out["warm_pool_error"] = "no standby zygote within 120s"
+
+        # ---- phase 2: traffic at 1 replica (baseline) ----
+        res1, errs1 = drive(pods, tenant_prompts(seed=101), "affine",
+                            threads=max_batch - 2)
+        out["replicas_1"] = res1
+        baseline_rate = res1["mean_per_replica_hit_rate"]
+
+        # ---- phase 3: queue burst -> sched-signal scale-up (warm) ----
+        claims0 = pool.claims
+        burst_pods = list(pods)
+        burst_prompts = tenant_prompts(seed=202) * 2   # deep queue
+        t_signal = time.time()
+        t_claim = [None]
+
+        def watch_claim():
+            while not stop.is_set() and t_claim[0] is None:
+                if pool.claims > claims0:
+                    t_claim[0] = time.time()
+                    return
+                time.sleep(0.05)
+        threading.Thread(target=watch_claim, daemon=True).start()
+        burst_res = [None]
+
+        def burst():
+            burst_res[0] = drive(burst_pods, burst_prompts, "affine",
+                                 threads=4 * max_batch)[0]
+        bt = threading.Thread(target=burst, daemon=True)
+        bt.start()
+        two = wait_ready(2)
+        t_ready = time.time()
+        bt.join(timeout=300)
+        new_pod = next(p for p in two if p.name != pods[0].name)
+        s_new = replica_stats(new_pod)
+        out["scale_up"] = {
+            "trigger": "kft_model_sched_* queue burst (ServingTicker "
+                       "scrape -> Autoscaler scale-to-2)",
+            "claimed_pod": new_pod.name,
+            "signal_to_claim_seconds": round(
+                (t_claim[0] or t_ready) - t_signal, 2),
+            "claim_to_ready_seconds": round(
+                t_ready - (t_claim[0] or t_signal), 2),
+            "total_replica_add_seconds": round(t_ready - t_signal, 2),
+            # in-replica decomposition: engine/model build vs decode-
+            # program acquisition; outcome "hit" = deserialize of the
+            # entry replica #1 published (no cold compile on this path;
+            # anything else is the counted degraded fallback)
+            "model_load_seconds": s_new.get("load_seconds"),
+            "precompile_seconds": s_new.get("precompile_seconds"),
+            "depot_outcome": s_new.get("depot_outcome"),
+            "depot_counters": s_new.get("depot", {}),
+            "vs_cold_replica_add": round(
+                (t_ready - t_signal) / max(1e-9,
+                                           out["cold_replica_add_seconds"]),
+                3),
+            "note": ("tiny-model caveat: the 'cold' baseline here pays "
+                     "page-cache-warm imports and a sub-second compile, "
+                     "so warm-vs-cold wall ratios understate the lever; "
+                     "the signal is the DECOMPOSITION — claim + model "
+                     "load + depot fetch, none of which grows with model "
+                     "compile time (the kube train bench measures the "
+                     "real cold import/compile cost directly)"),
+        }
+        out["warm_pool"] = pool.snapshot()
+
+        # ---- phase 4: traffic at 2 replicas, affine vs random ----
+        res2, errs2 = drive(two, tenant_prompts(seed=303), "affine",
+                            threads=2 * (max_batch - 2))
+        res2r, errs2r = drive(two, tenant_prompts(seed=404), "random",
+                              threads=2 * (max_batch - 2))
+        out["replicas_2_affine"] = res2
+        out["replicas_2_random"] = res2r
+        out["rps_scaling_2_vs_1"] = round(
+            res2["requests_per_sec"]
+            / max(1e-9, res1["requests_per_sec"]), 3)
+        if baseline_rate:
+            out["hit_rate_vs_baseline_2_replicas"] = {
+                "affine": round(res2["mean_per_replica_hit_rate"]
+                                / baseline_rate, 4),
+                "random_diluted": round(res2r["mean_per_replica_hit_rate"]
+                                        / baseline_rate, 4),
+            }
+
+        # ---- phase 5: canary rollout, SLO-gated promote ----
+        ticker.autoscaler = None          # freeze the fleet for the split
+        with ticker.lock:
+            ctl.set_scale(ns, svc, 1)
+        canary = _dc.replace(
+            isvc.predictor,
+            env={**isvc.predictor.env, "KFT_CANARY_MARK": "1"},
+            canary_traffic_percent=50,
+            canary_slo=CanarySLO(max_error_rate=0.05, min_requests=15))
+        with ticker.lock:
+            ctl.apply(InferenceService(name=svc, namespace=ns,
+                                       predictor=canary))
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            st = ctl.get(ns, svc).status
+            if len(st.traffic) == 2:
+                break
+            time.sleep(0.2)
+        st = ctl.get(ns, svc).status
+        split_seen = dict(st.traffic)
+        # the split goes live on pod phase; gate traffic must wait for
+        # the canary replica's HTTP readiness or connection-refused reads
+        # as an SLO burn the revision didn't earn
+        wait_ready(1, revision=st.latest_revision)
+        # the ticker AUTO-ARMS the gate from PredictorSpec.canary_slo —
+        # the data plane reads it back to feed outcomes (e2e proof the
+        # spec field drives the rollout, no manual attach)
+        gate = None
+        deadline = time.time() + 30
+        while gate is None and time.time() < deadline:
+            gate = ticker.canary_gate(ns, svc)
+            time.sleep(0.2)
+        if gate is None:
+            raise TimeoutError("ticker never armed the canary gate")
+        rev_of = {int(p.labels["revision"]): p for p in predictor_pods()}
+        splitter = TrafficSplitter(seed=5)
+        counts = collections.Counter()
+        prompts5 = tenant_prompts(seed=505)
+        for i, prompt in enumerate(prompts5[:60]):
+            traffic = ctl.get(ns, svc).status.traffic
+            rev = splitter.pick(traffic, request_id=f"canary-{i}")
+            pod = rev_of.get(rev) or next(iter(rev_of.values()))
+            counts[rev] += 1
+            t1 = time.perf_counter()
+            try:
+                predict(pod, prompt)
+                ok = True
+            except Exception:
+                ok = False
+            if rev == max(rev_of):
+                gate.observe(ok, time.perf_counter() - t1)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = ctl.get(ns, svc).status
+            if st.traffic.get(st.latest_revision) == 100 and \
+                    st.ready_revision == st.latest_revision:
+                break
+            time.sleep(0.2)
+        st = ctl.get(ns, svc).status
+        out["canary"] = {
+            "split_seen": {str(k): v for k, v in split_seen.items()},
+            "routed_by_revision": {str(k): v for k, v in counts.items()},
+            "canary_requests": gate.requests,
+            "canary_errors": gate.errors,
+            "decision": "promote" if st.ready_revision == st.latest_revision
+                        and st.traffic.get(st.latest_revision) == 100
+                        else "undecided",
+            "promoted_revision": st.ready_revision,
+            "slo": {"max_error_rate": 0.05, "min_requests": 15},
+        }
+        out["errors"] = {
+            "replicas_1": errs1[:3], "replicas_2_affine": errs2[:3],
+            "replicas_2_random": errs2r[:3],
+            "burst": (burst_res[0] or {}).get("errors")
+            if isinstance(burst_res[0], dict) else None,
+        }
+        out["backend"] = ("KubeCluster + fake apiserver + image-less "
+                          "kubelet; replicas are real processes")
+        return out
+    except Exception as e:                    # never sink the bench line
+        import traceback
+
+        return {"error": f"{type(e).__name__}: {e}",
+                "trace": traceback.format_exc()[-2000:]}
+    finally:
+        cleanup()
 
 
 def _kernel_parity(on_tpu: bool) -> dict:
@@ -1138,6 +1725,67 @@ def spec_smoke_main():
     return 0 if ok else 1
 
 
+def fleet_smoke_main():
+    """``bench.py --fleet-smoke``: the multi-replica serving fleet (CPU,
+    CI-runnable) as one JSON line — the `make test-fleet` acceptance
+    entry point. Runs the in-process affinity sweep (per-replica
+    prefix-hit preservation under prefix-affine routing vs the measured
+    random-routing dilution) and the kube fleet e2e (real replica
+    processes, sched-signal autoscale, WARM scale-up claim with depot
+    fetch, canary promote). Exits nonzero unless >=2 replicas really
+    served traffic, a real warm-claim scale-up occurred, and the JSON
+    carries the per-replica hit-rate and scale-latency fields."""
+    import tempfile
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving.jax_model import enable_compile_cache
+
+    # amortize the 13 tiny-engine builds of the sweep across one disk
+    # compile cache (identical programs; the measurement windows exclude
+    # warmup either way)
+    enable_compile_cache(tempfile.mkdtemp(prefix="kft-fleet-xla-"))
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(1), cfg, dtype=jnp.bfloat16)
+    sweep = _fleet_affinity_sweep(params, cfg, False)
+    del params
+    kube = _fleet_kube_bench()
+    out = {"affinity_sweep": sweep, "kube_fleet": kube}
+    print(json.dumps({
+        "metric": "fleet_requests_per_sec_2_replicas",
+        "value": (kube.get("replicas_2_affine") or {}).get(
+            "requests_per_sec"),
+        "unit": "req/s",
+        "extra": out,
+    }))
+    scale = kube.get("scale_up") or {}
+    two = kube.get("replicas_2_affine") or {}
+    served = [p for p in (two.get("per_replica") or {}).values()
+              if p.get("generated_tokens", 0) > 0]
+    ratios = sweep.get("hit_rate_vs_baseline_2_replicas") or {}
+    ok = ("error" not in sweep and "error" not in kube
+          # >=2 replicas really served traffic
+          and len(served) >= 2
+          # a real warm-claim scale-up occurred
+          and (kube.get("warm_pool") or {}).get("claims", 0) >= 1
+          # scale-latency decomposition fields present
+          and scale.get("total_replica_add_seconds") is not None
+          and scale.get("claim_to_ready_seconds") is not None
+          and scale.get("model_load_seconds") is not None
+          and scale.get("precompile_seconds") is not None
+          # the depot outcome is IN the JSON (a fallback is a counted
+          # degraded path, not a smoke failure)
+          and scale.get("depot_outcome") is not None
+          # per-replica hit-rate fields present + affine preservation
+          # within 15% of the single-replica baseline
+          and all("prefix_hit_rate" in p
+                  for p in (two.get("per_replica") or {}).values())
+          and ratios.get("affine") is not None
+          and ratios["affine"] >= 0.85
+          and ratios.get("random_diluted") is not None
+          and kube.get("canary", {}).get("decision") == "promote")
+    return 0 if ok else 1
+
+
 def kube_main():
     """``bench.py --cluster kube``: ONLY the kube-backend warm-pool
     latency bench (CPU-safe, CI-runnable) as one JSON line — the make
@@ -1180,9 +1828,17 @@ if __name__ == "__main__":
                          "model (CI smoke; nonzero exit unless greedy "
                          "output is token-identical and "
                          "accepted_tokens_per_step >= 1)")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="only the multi-replica fleet bench on the tiny "
+                         "model (CI smoke; nonzero exit unless >=2 "
+                         "replicas served, a warm-claim scale-up "
+                         "happened, and per-replica hit-rate + "
+                         "scale-latency fields are in the JSON)")
     cli = ap.parse_args()
     if cli.serving_smoke:
         sys.exit(serving_smoke_main())
     if cli.spec_smoke:
         sys.exit(spec_smoke_main())
+    if cli.fleet_smoke:
+        sys.exit(fleet_smoke_main())
     sys.exit(kube_main() if cli.cluster == "kube" else main())

@@ -167,13 +167,22 @@ class KubeCluster:
                  ca_file: Optional[str] = None,
                  insecure_skip_verify: bool = False,
                  image: str = "kubeflow-tpu/runtime:latest",
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0,
+                 host_ports: bool = False):
         u = urlparse(base_url)
         self.scheme = u.scheme or "http"
         self.host = u.hostname
         self.port = u.port or (443 if self.scheme == "https" else 80)
         self.image = image
         self.timeout = request_timeout
+        if host_ports:
+            # image-less single-host mode (FakeKubelet runs every pod on
+            # THIS machine): expose the allocate_port hook so per-pod
+            # binds (serving KFT_BIND) get distinct loopback ports — the
+            # pod-IP analogue. Real clusters keep container ports.
+            from kubeflow_tpu.controller.cluster import _free_port
+
+            self.allocate_port = _free_port
         if token is None and os.path.exists(f"{_SA_DIR}/token"):
             with open(f"{_SA_DIR}/token") as f:
                 token = f.read().strip()
@@ -261,9 +270,19 @@ class KubeCluster:
 
     # ------------------------------------------------------ pod verbs --
 
+    def _claim_eligible(self, pod: Pod) -> bool:
+        """True when admission will try a warm-pool claim for this pod.
+        Claim-eligible pods are created GATED even when they are not gang
+        pods (serving predictor replicas): an ungated manifest would let
+        the node agent cold-spawn the twin in the window between create
+        and the claim that deletes it — two processes racing one bind."""
+        return self.warm_pool is not None and self.warm_pool.eligible(pod)
+
     def create_pod(self, pod: Pod) -> None:
         key = (pod.namespace, pod.name)
         manifest = pod_to_manifest(pod, self.image)
+        if not pod.gang and self._claim_eligible(pod):
+            manifest["spec"]["schedulingGates"] = [{"name": GANG_GATE}]
         try:
             doc = self._request("POST", self._pod_path(pod.namespace),
                                 manifest)
@@ -308,7 +327,7 @@ class KubeCluster:
                 # never resurrects), and the fresh rv fences out the old
                 # incarnation's lagging watch events.
                 self._pods[key] = pod
-            if pod.gang:
+            if pod.gang or self._claim_eligible(pod):
                 self._gated.add(key)
             self._pushed_env[key] = dict(pod.env)
 

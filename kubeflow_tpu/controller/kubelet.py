@@ -53,6 +53,8 @@ class FakeKubelet:
         self.procs: dict[tuple[str, str], subprocess.Popen] = {}
         self._announced: set[tuple[str, str]] = set()
         self._reported: set[tuple[str, str]] = set()    # terminal reported
+        self._starting: set[tuple[str, str]] = set()    # init step running
+        self._spawned_at: dict[tuple[str, str], float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(log_dir, exist_ok=True)
@@ -91,7 +93,25 @@ class FakeKubelet:
             if pod is None:
                 continue
             key = (pod.namespace, pod.name)
-            if (key not in self.procs and pod.scheduled
+            if (key in self.procs and pod.scheduled
+                    and pod.phase == PodPhase.PENDING
+                    and self.procs[key].poll() is None
+                    # grace window: an async-init spawn finishing between
+                    # the list snapshot and this iteration also reads
+                    # (live proc, snapshot-Pending) — a genuine dead
+                    # incarnation stays Pending far longer than the
+                    # spawn->Running report takes
+                    and time.time() - self._spawned_at.get(key, 0) > 2.0):
+                # a Pending pod backed by a live local process is a NEW
+                # incarnation of the name whose delete+recreate fell
+                # between two polls: the process belongs to the dead
+                # incarnation — kill it or the new pod wedges Pending
+                # forever behind the zombie's key
+                self._kill(self.procs.pop(key))
+                self._announced.discard(key)
+                self._reported.discard(key)
+            if (key not in self.procs and key not in self._starting
+                    and pod.scheduled
                     and pod.phase == PodPhase.PENDING and pod.command):
                 # a Pending pod we already reported terminal is a NEW
                 # incarnation of the name (gang restart deletes+recreates)
@@ -104,6 +124,8 @@ class FakeKubelet:
             self._kill(self.procs.pop(key))
             self._announced.discard(key)
             self._reported.discard(key)
+        # _starting keys clear themselves when their init thread finishes;
+        # a deleted pod's late spawn is reaped by the loop above next pass
 
     def _spawn(self, pod) -> None:
         key = (pod.namespace, pod.name)
@@ -115,6 +137,40 @@ class FakeKubelet:
             os.unlink(self._announce_path(key))
         except FileNotFoundError:
             pass
+        if pod.init_command:
+            # initContainer contract (the storage-initializer role): runs
+            # to completion before the main command, OFF the sync loop —
+            # a slow storage download must not freeze every other pod's
+            # spawn/announce/exit reporting (the local backend runs the
+            # same contract async for the same reason)
+            self._starting.add(key)
+            threading.Thread(target=self._init_then_spawn,
+                             args=(pod, key, env), daemon=True,
+                             name=f"kubelet-init-{pod.name}").start()
+            return
+        self._main_spawn(pod, key, env)
+
+    def _init_then_spawn(self, pod, key, env) -> None:
+        try:
+            with open(self._log_path(key), "ab") as log:
+                try:
+                    rc = subprocess.run(
+                        pod.init_command, env=env, stdout=log,
+                        stderr=subprocess.STDOUT, timeout=300).returncode
+                except (OSError, subprocess.TimeoutExpired) as e:
+                    log.write(f"kubelet init failed: {e}\n".encode())
+                    rc = -1
+                if rc != 0:
+                    log.write(
+                        f"kubelet: init command exited {rc}\n".encode())
+                    self._set_phase(key, PodPhase.FAILED, rc)
+                    self._reported.add(key)
+                    return
+            self._main_spawn(pod, key, env)
+        finally:
+            self._starting.discard(key)
+
+    def _main_spawn(self, pod, key, env) -> None:
         log = open(self._log_path(key), "ab")
         try:
             proc = subprocess.Popen(
@@ -126,6 +182,7 @@ class FakeKubelet:
             self._reported.add(key)
             return
         log.close()                     # the child owns its copy of the fd
+        self._spawned_at[key] = time.time()
         self.procs[key] = proc
         self._set_phase(key, PodPhase.RUNNING)
 

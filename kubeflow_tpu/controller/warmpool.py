@@ -155,9 +155,15 @@ class WarmPoolController:
     # ------------------------------------------------------ eligibility --
 
     def eligible(self, pod: Pod) -> bool:
-        """Only gang (job) pods with a zygote-forkable command claim from
-        the pool; serving/notebook pods keep their own lifecycle."""
-        return pod.gang and zygote_eligible(pod.command)
+        """Gang (job) pods AND serving predictor replicas with a
+        zygote-forkable command claim from the pool — a fleet scale-up
+        replica must fork pre-imported, not pay a cold interpreter.
+        Pods with an init step (storage initializer) must cold-start:
+        the zygote only execs the main command. Notebook/transformer/
+        explainer pods keep their own lifecycle."""
+        if not zygote_eligible(pod.command) or pod.init_command:
+            return False
+        return pod.gang or pod.labels.get("component") == "predictor"
 
     @staticmethod
     def pool_class_for(pod: Pod) -> str:

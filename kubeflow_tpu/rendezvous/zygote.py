@@ -62,14 +62,18 @@ _fork_lock = threading.Lock()
 
 
 def _preimport() -> None:
-    """The heavy import set a training worker pays cold."""
+    """The heavy import set a training worker OR serving replica pays
+    cold. Serving joined in the fleet round: a warm-pool scale-up forks
+    the predictor runtime from this zygote, so its module tree must be
+    resident too (none of it initializes a backend — asserted below)."""
     import jax  # noqa: F401
     import jax.numpy  # noqa: F401
     import numpy  # noqa: F401
     import optax  # noqa: F401
 
-    from kubeflow_tpu import models, training  # noqa: F401
+    from kubeflow_tpu import models, serving, training  # noqa: F401
     from kubeflow_tpu.rendezvous import bootstrap  # noqa: F401
+    from kubeflow_tpu.serving import runtime  # noqa: F401
 
     # invariant the whole design rests on: imports must not have touched a
     # backend (a forked live TPU/CPU client would be corrupt)

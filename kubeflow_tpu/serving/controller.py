@@ -1,14 +1,29 @@
-"""InferenceService controller + runtime selection + canary rollout.
+"""InferenceService controller + runtime selection + canary rollout +
+fleet autoscaling on scheduler signals.
 
 Parity: SURVEY.md §2.4 'InferenceService controller' and §3.3 — reconcile
 predictor/transformer/explainer into runtime pods (the raw-Deployment mode;
 serverless scale-to-zero arrives with the autoscaler), select a
 ServingRuntime by model format, track revisions, and split traffic between
 the previous ready revision and the canary revision.
+
+Fleet layer: the ``Autoscaler`` consumes the per-replica
+``kft_model_sched_*`` family the step scheduler exports (queue depth,
+token backlog, slot occupancy) — not just probe concurrency — and makes
+scale-to-N decisions with a hysteresis window (scale up immediately on
+demand; scale down only after ``idle_grace_seconds`` of sustained low
+signal, never below min_replicas, never mid-canary). On the kube backend
+a scale-up predictor pod CLAIMS a warm-pool standby
+(``controller/warmpool.py``) whose claim pre-fetched the executable depot
+(``parallel/depot.py``) — replica add is bounded by warm-claim +
+depot-fetch time, not a cold interpreter + compile. ``CanaryGate``
+promotes or rolls back a revision split on an error-rate/latency SLO.
 """
 
 from __future__ import annotations
 
+import math
+import os
 import sys
 import threading
 import time
@@ -153,14 +168,24 @@ class ServingController:
         for pod in self._pods(isvc, revision=latest):
             if pod.phase == PodPhase.FAILED:
                 self.cluster.delete_pod(isvc.namespace, pod.name)
-        # scale-down: drop excess predictor pods highest-index-first
+        # scale-down: drop excess predictor pods highest-index-first, BY
+        # INDEX IDENTITY — get_pod(revN-i) resolves the warm-claim alias,
+        # so a claimed replica (serving under the standby pod's own name)
+        # is deleted as the index the controller created it for. Deleting
+        # by a name sort instead would delete a pod the creation loop
+        # below immediately recreates: a perpetual churn loop.
         want = self._predictor_replicas(isvc)
-        predictors = sorted(
-            (p for p in self._pods(isvc, revision=latest)
-             if p.labels.get("component") == "predictor"),
-            key=lambda p: int(p.name.rsplit("-", 1)[-1]))
-        for pod in predictors[want:]:
-            self.cluster.delete_pod(isvc.namespace, pod.name)
+        n_pred = sum(1 for p in self._pods(isvc, revision=latest)
+                     if p.labels.get("component") == "predictor")
+        # scan bound covers every index the controller can have created:
+        # live-count alone would miss a high index exposed by failed-pod
+        # gaps below it (max_replicas bounds autoscaler-created indices)
+        bound = max(want + n_pred, isvc.predictor.max_replicas)
+        for i in range(want, bound):
+            pod = self.cluster.get_pod(
+                isvc.namespace, _pod_name(isvc, "predictor", latest, i))
+            if pod is not None:
+                self.cluster.delete_pod(isvc.namespace, pod.name)
         self._create_revision_pods(isvc, runtime, latest)
         if self._revision_ready(isvc, latest):
             prev = isvc.status.ready_revision
@@ -281,6 +306,13 @@ class ServingController:
                     pod_env = dict(env)
                     if comp == "predictor":
                         pod_env["KFT_BIND"] = self._bind_for_pod()
+                        if pod_env.get("KFT_DEPOT_CACHE"):
+                            # pod-LOCAL depot cache (pods do not share
+                            # node disks on a real cluster): the warm
+                            # pool pre-fetches executables into exactly
+                            # this directory at claim time
+                            pod_env["KFT_DEPOT_CACHE"] = os.path.join(
+                                pod_env["KFT_DEPOT_CACHE"], pname)
                     pod = Pod(
                         name=pname, namespace=isvc.namespace,
                         labels={"isvc": isvc.name, "component": comp,
@@ -316,24 +348,72 @@ class ServingController:
                 self.cluster.delete_pod(isvc.namespace, pod.name)
 
 
+def _mid_canary(isvc: InferenceService) -> bool:
+    """True while an old/new revision traffic split is in flight."""
+    st = isvc.status
+    return bool(st.ready_revision
+                and st.latest_revision != st.ready_revision)
+
+
 class ServingTicker:
     """Daemon glue for the serving layer: one ``tick()`` reconciles every
-    InferenceService and applies the autoscaler from a concurrency source.
+    InferenceService, applies the autoscaler, and drives any attached
+    canary gate to a promote/rollback decision.
 
-    The default source scrapes ``kft_requests_in_flight`` from each ready
-    predictor pod's /metrics (the KPA-scrape role); tests inject a callable.
+    Scale signals come from ``signals_of`` — by default a scrape of each
+    ready predictor pod's ``kft_model_sched_*`` family (queue depth, token
+    backlog, slot occupancy: the step-scheduler counters that ride
+    /metrics and the ``/v2/models/{name}/stats`` JSON view) — falling
+    back to the legacy ``kft_requests_in_flight`` concurrency probe for
+    pods that export no scheduler family. Tests inject either callable.
     """
 
     def __init__(self, controller: ServingController,
                  autoscaler: Optional["Autoscaler"] = None,
-                 concurrency_of=None, lock=None):
+                 concurrency_of=None, signals_of=None, lock=None):
         self.controller = controller
         self.autoscaler = autoscaler
         self.concurrency_of = concurrency_of or self._probe_concurrency
-        # mutation lock (the operator injects its own): the concurrency
-        # probe does blocking HTTP and must NOT hold it — a slow predictor
-        # pod must never stall job reconcile/heartbeat/API threads
+        # a caller that injected ONLY a concurrency source keeps it: the
+        # signal probe must not silently outrank an explicit injection
+        if signals_of is None and concurrency_of is not None:
+            signals_of = lambda isvc: []            # noqa: E731
+        self.signals_of = signals_of or self._probe_signals
+        # canary SLO gates by (namespace, name) -> (gate, revision armed
+        # for): attach_canary() wires one explicitly, or a live split
+        # whose PredictorSpec carries canary_slo auto-arms one; decide()
+        # verdicts are enacted via the controller's promote/rollback.
+        # The armed revision makes stale gates impossible: a split
+        # resolved by ANY path (manual promote/rollback, new revision)
+        # drops its gate instead of letting old observations decide the
+        # next rollout.
+        self._canaries: dict[tuple[str, str],
+                             tuple["CanaryGate", int]] = {}
+        # mutation lock (the operator injects its own): the signal/
+        # concurrency probes do blocking HTTP and must NOT hold it — a
+        # slow predictor pod must never stall job reconcile/heartbeat/API
+        # threads
         self.lock = lock or threading.Lock()
+
+    def attach_canary(self, namespace: str, name: str,
+                      gate: "CanaryGate") -> None:
+        """Arm SLO-gated rollout for a service: while its canary split is
+        live, each tick asks ``gate.decide()`` and enacts the verdict.
+        Attaching BEFORE the rollout is applied arms the gate for the
+        next split to go live; attaching mid-split arms it for that
+        split."""
+        isvc = self.controller.get(namespace, name)
+        rev = (isvc.status.latest_revision
+               if isvc is not None and _mid_canary(isvc) else None)
+        self._canaries[(namespace, name)] = (gate, rev)
+
+    def canary_gate(self, namespace: str, name: str
+                    ) -> Optional["CanaryGate"]:
+        """The gate armed for a service's live split (explicitly attached
+        or auto-armed from ``PredictorSpec.canary_slo``) — the data plane
+        feeds canary outcomes into it via ``observe``."""
+        entry = self._canaries.get((namespace, name))
+        return entry[0] if entry else None
 
     def _probe_concurrency(self, isvc: InferenceService) -> float:
         import urllib.request
@@ -353,34 +433,149 @@ class ServingTicker:
                 continue
         return total
 
+    def _probe_signals(self, isvc: InferenceService) -> list[dict]:
+        """Per-replica scheduler signals for the latest revision's running
+        predictor pods: the ``/v2/models/{name}/stats`` JSON ``sched``
+        family first (one parse-free read), the ``kft_model_sched_*``
+        /metrics lines as fallback. A pod exporting neither contributes
+        nothing — an all-empty result makes tick() fall back to the
+        legacy concurrency probe."""
+        import json as _json
+        import urllib.request
+
+        out: list[dict] = []
+        for pod in self.controller._pods(
+                isvc, revision=isvc.status.latest_revision):
+            bind = pod.env.get("KFT_BIND")
+            if not bind or pod.phase != PodPhase.RUNNING:
+                continue
+            sched: dict = {}
+            try:
+                with urllib.request.urlopen(
+                        f"http://{bind}/v2/models/{isvc.name}/stats",
+                        timeout=1.0) as r:
+                    sched = (_json.loads(r.read()).get("sched") or {})
+            except Exception:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{bind}/metrics", timeout=1.0) as r:
+                        text = r.read().decode()
+                    prefix = "kft_model_sched_"
+                    for line in text.splitlines():
+                        if not line.startswith(prefix):
+                            continue
+                        name = line.split("{")[0][len(prefix):]
+                        try:
+                            sched[name] = float(line.rsplit(None, 1)[-1])
+                        except ValueError:
+                            continue
+                except Exception:
+                    continue
+            if sched:
+                sched["replica"] = pod.name
+                out.append(sched)
+        return out
+
     def tick(self) -> None:
         for (ns, name) in list(self.controller.services.keys()):
             with self.lock:
                 isvc = self.controller.reconcile(ns, name)
-            if self.autoscaler is None or isvc is None:
+            if isvc is None:
+                continue
+            self._tick_canary(ns, name, isvc)
+            if self.autoscaler is None:
                 continue
             # a scaled-to-zero service keeps status.ready (its revision
             # wants zero pods), so the activator wake path passes this
             # guard; only genuinely not-ready services are left alone
             if not isvc.status.ready:
                 continue
-            concurrency = self.concurrency_of(isvc)     # unlocked HTTP
+            # scale_metric="concurrency" pins the legacy in-flight probe;
+            # the default "sched" prefers the scheduler-signal family and
+            # falls back to concurrency for pods exporting none
+            signals = ([] if isvc.predictor.scale_metric == "concurrency"
+                       else self.signals_of(isvc))      # unlocked HTTP
+            concurrency = (self.concurrency_of(isvc)
+                           if not signals else None)
             with self.lock:
-                desired = self.autoscaler.scale(isvc, concurrency)
+                desired = self.autoscaler.scale(
+                    isvc, concurrency, signals=signals,
+                    current=self.controller._predictor_replicas(isvc))
                 if desired != self.controller._predictor_replicas(isvc):
                     self.controller.set_scale(ns, name, desired)
 
+    def _tick_canary(self, ns: str, name: str,
+                     isvc: InferenceService) -> None:
+        key = (ns, name)
+        if not _mid_canary(isvc):
+            # split resolved by any path (gate verdict, manual promote/
+            # rollback): the gate's observations are history, not a head
+            # start for the next rollout. A PRE-armed gate (rev None,
+            # attached ahead of the rollout) keeps waiting for its split.
+            entry = self._canaries.get(key)
+            if entry is not None and entry[1] is not None:
+                self._canaries.pop(key, None)
+            return
+        latest = isvc.status.latest_revision
+        entry = self._canaries.get(key)
+        if entry is not None and entry[1] is None:
+            # pre-armed gate (attached before the rollout): bind it to
+            # the split that just went live
+            entry = (entry[0], latest)
+            self._canaries[key] = entry
+        if entry is not None and entry[1] != latest:
+            self._canaries.pop(key, None)       # armed for an older split
+            entry = None
+        if entry is None:
+            # auto-arm from the spec: canary_slo makes the gate without a
+            # manual attach_canary (the data plane reads it back via
+            # canary_gate() to feed observations)
+            slo = isvc.predictor.canary_slo
+            if slo is None:
+                return
+            entry = (CanaryGate(max_error_rate=slo.max_error_rate,
+                                max_p95_latency_s=slo.max_p95_latency_s,
+                                min_requests=slo.min_requests), latest)
+            self._canaries[key] = entry
+        verdict = entry[0].decide()
+        if verdict is None:
+            return
+        with self.lock:
+            if verdict == "promote":
+                self.controller.promote(ns, name)
+            else:
+                self.controller.rollback(ns, name)
+        self._canaries.pop(key, None)
+
 
 class Autoscaler:
-    """Concurrency-driven replica scaling for the raw-deployment mode (the
-    reference's HPA/KPA role). ``observe`` feeds it per-service concurrency;
-    ``scale`` returns the desired replica count clamped to min/max, with
-    scale-to-zero when min_replicas == 0 and the service has been idle past
-    the grace period."""
+    """Replica scaling for the raw-deployment mode (the reference's
+    HPA/KPA role), now consuming the per-replica scheduler-signal family.
 
-    def __init__(self, idle_grace_seconds: float = 30.0):
+    ``scale`` takes either a legacy concurrency float or ``signals`` — a
+    list of per-replica ``kft_model_sched_*`` dicts (queue_depth,
+    occupancy_slots, token_backlog) — and returns the desired replica
+    count clamped to min/max. Demand is slot-shaped: occupied slots plus
+    queued requests, at ``scale_target`` slots per replica, with the
+    fleet token backlog as a second scale-up trigger
+    (``backlog_tokens_per_replica``) so long-prompt queues scale before
+    queue_depth alone would.
+
+    Flap control: scale-up applies immediately; scale-DOWN only after the
+    demand has stayed below the current size for ``idle_grace_seconds``
+    (the hysteresis window), never below min_replicas, and never while a
+    canary split is in flight — shrinking the fleet mid-rollout would
+    fold the error-budget measurement into pod churn. Scale-to-zero
+    (min_replicas == 0) keeps its own idle-grace clock and is exempt
+    from the second window (its grace already elapsed)."""
+
+    def __init__(self, idle_grace_seconds: float = 30.0,
+                 backlog_tokens_per_replica: int = 0):
         self.idle_grace = idle_grace_seconds
+        self.backlog_tokens_per_replica = int(backlog_tokens_per_replica)
         self._last_busy: dict[tuple[str, str], float] = {}
+        self._low_since: dict[tuple[str, str], float] = {}
+        self._applied: dict[tuple[str, str], int] = {}
 
     def wake(self, namespace: str, name: str,
              now: Optional[float] = None) -> None:
@@ -390,17 +585,103 @@ class Autoscaler:
         self._last_busy[(namespace, name)] = (
             time.time() if now is None else now)
 
-    def scale(self, isvc: InferenceService, concurrency: float,
-              now: Optional[float] = None) -> int:
+    def scale(self, isvc: InferenceService,
+              concurrency: Optional[float] = None,
+              now: Optional[float] = None, *,
+              signals: Optional[list] = None,
+              current: Optional[int] = None) -> int:
         now = time.time() if now is None else now
         key = (isvc.namespace, isvc.name)
         p = isvc.predictor
-        if concurrency > 0:
+        if signals:
+            slots = sum(float(s.get("occupancy_slots", 0)) for s in signals)
+            queued = sum(float(s.get("queue_depth", 0)) for s in signals)
+            backlog = sum(float(s.get("token_backlog", 0)) for s in signals)
+            demand = slots + queued
+            desired = math.ceil(demand / max(1, p.scale_target))
+            if self.backlog_tokens_per_replica > 0:
+                desired = max(desired, math.ceil(
+                    backlog / self.backlog_tokens_per_replica))
+            busy = demand > 0 or backlog > 0
+        else:
+            concurrency = concurrency or 0.0
+            desired = math.ceil(concurrency / max(1, p.scale_target))
+            busy = concurrency > 0
+        if busy:
             self._last_busy[key] = now
-        desired = int(-(-concurrency // max(1, p.scale_target)))  # ceil
+        scaled_to_zero = False
         if p.min_replicas == 0:
             idle_since = self._last_busy.get(key, 0.0)
-            if concurrency == 0 and now - idle_since > self.idle_grace:
-                return 0
-            desired = max(1, desired)
-        return max(p.min_replicas, min(p.max_replicas, desired))
+            if (not busy and now - idle_since > self.idle_grace
+                    and not _mid_canary(isvc)):
+                # a live canary split is never collapsed to zero — the
+                # gate could then never accumulate its min_requests
+                desired, scaled_to_zero = 0, True
+            else:
+                desired = max(1, desired)
+        desired = max(p.min_replicas, min(p.max_replicas, desired))
+        cur = current if current is not None else self._applied.get(key)
+        if cur is not None and desired < cur and not scaled_to_zero:
+            if _mid_canary(isvc):
+                # never shrink mid-canary; restart the low-signal clock
+                self._low_since.pop(key, None)
+                desired = cur
+            else:
+                low_since = self._low_since.setdefault(key, now)
+                if now - low_since < self.idle_grace:
+                    desired = cur          # hold until the window elapses
+        else:
+            self._low_since.pop(key, None)
+        self._applied[key] = desired
+        return desired
+
+
+class CanaryGate:
+    """SLO gate for an old/new-revision traffic split: the data plane
+    reports each canary-revision outcome via ``observe``; ``decide``
+    answers None (keep splitting), "promote" (error rate and latency
+    within SLO over at least ``min_requests``) or "rollback" (error
+    budget burned — decided the moment the burn is provable, without
+    waiting for min_requests). The ServingTicker enacts the verdict
+    through ``ServingController.promote`` / ``rollback``."""
+
+    def __init__(self, max_error_rate: float = 0.02,
+                 max_p95_latency_s: float = 0.0, min_requests: int = 20):
+        self.max_error_rate = float(max_error_rate)
+        self.max_p95_latency_s = float(max_p95_latency_s)
+        self.min_requests = int(min_requests)
+        self.requests = 0
+        self.errors = 0
+        self._latencies: list[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, ok: bool, latency_s: float = 0.0) -> None:
+        with self._lock:
+            self.requests += 1
+            if not ok:
+                self.errors += 1
+            else:
+                self._latencies.append(float(latency_s))
+
+    def p95_latency(self) -> float:
+        with self._lock:
+            if not self._latencies:
+                return 0.0
+            xs = sorted(self._latencies)
+            return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+    def decide(self) -> Optional[str]:
+        with self._lock:
+            n, errors = self.requests, self.errors
+        if n and errors / n > self.max_error_rate and (
+                # the budget is provably burned once even an all-ok
+                # remainder of the min_requests window couldn't recover
+                n >= self.min_requests
+                or errors > self.max_error_rate * self.min_requests):
+            return "rollback"
+        if n < self.min_requests:
+            return None
+        if self.max_p95_latency_s > 0 and (
+                self.p95_latency() > self.max_p95_latency_s):
+            return "rollback"
+        return "promote"

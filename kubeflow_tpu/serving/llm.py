@@ -334,6 +334,14 @@ class LLMEngine:
         self._decode = jax.jit(
             self._decode_impl, donate_argnums=(2,),
             static_argnames=("greedy_only", "kernel", "chunk_len"))
+        # AOT-compiled steady-state decode program, installed by
+        # precompile(): the executable-depot fast path for serving
+        # replicas — a fleet scale-up deserializes the program the first
+        # replica published instead of compiling it cold. Dispatches whose
+        # static config differs (non-greedy batch, adaptive chunk trim)
+        # fall back to the jitted path above.
+        self._compiled_decode = None
+        self.depot_outcome: Optional[str] = None
         # speculative verify: greedy target chain + chosen-token logprobs
         # for a [B, S] candidate batch in ONE dispatch. S is pow2-padded
         # by the caller, so the compile count is log2(spec_k+1) — the
@@ -404,6 +412,38 @@ class LLMEngine:
 
     # ---------------- public API ----------------
 
+    def precompile(self, depot=None, stats=None, wait_s: float = 0.0) -> str:
+        """Split the decode compile from request #1 (the serving analogue
+        of ``Trainer.precompile``): AOT-lower the steady-state decode
+        program — full ``decode_chunk``, greedy batch, the engine's
+        resolved kernel; the dominant program of the shared-system-prompt
+        serving workload — and compile it NOW, fetching the executable
+        from an executable depot (``parallel/depot.py``) when one is
+        given and publishing on a miss. A fleet scale-up replica whose
+        warm-pool claim pre-fetched the entry therefore deserializes in
+        place of the cold compile; every degraded path stays a counted
+        local compile (depot fallback semantics), never a failure.
+        Returns the depot outcome ("hit" / "published" / "compiled" /
+        "no_depot"), also kept as ``self.depot_outcome``. Other compile
+        variants (non-greedy batches, adaptive chunk trims, prefill
+        widths) still compile lazily via the jitted path — the
+        persistent XLA compile cache covers those across replicas."""
+        from kubeflow_tpu.parallel.depot import load_or_compile
+
+        b = self.max_batch
+        lowered = self._decode.lower(
+            self.params, jnp.zeros((b,), jnp.int32), self.cache,
+            jnp.zeros((b, self.paged.max_blocks_per_seq), jnp.int32),
+            jnp.zeros((b,), bool), jnp.zeros((b,), jnp.float32),
+            jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.float32),
+            jax.random.key(0), greedy_only=True, kernel=self.kernel,
+            chunk_len=self.decode_chunk)
+        self._compiled_decode, outcome = load_or_compile(
+            lowered, depot, mesh=self.mesh, stats=stats, wait_s=wait_s,
+            extra=("serving-decode",))
+        self.depot_outcome = outcome
+        return outcome
+
     def validate_prompt(self, prompt: Sequence[int],
                         sampling: Optional[SamplingParams] = None) -> None:
         """Raise if the prompt can't be served. Called by add_request; also
@@ -459,15 +499,29 @@ class LLMEngine:
 
     def scheduler_stats(self) -> dict:
         """Scheduler counters + gauges for /metrics (occupancy, queue
-        depth, prefix-hit and preempt counters — the serving controller's
-        autoscale/affinity signals, ROADMAP item 2)."""
+        depth, token backlog, prefix-hit and preempt counters — the
+        serving controller's autoscale/affinity signals)."""
         with self._lock:
             waiting = len(self._waiting)
+            # token backlog: prompt + generation budget of queued requests
+            # plus the un-prefilled remainder of in-flight chunked prompts
+            # — the work this replica owes but has not scheduled, the
+            # scale-up signal queue_depth alone understates for long
+            # prompts
+            backlog = sum(len(r.prompt) + r.sampling.max_tokens
+                          for r in self._waiting)
+        # the step loop mutates _chunked WITHOUT the lock: snapshot the
+        # values in one C-level call (GIL-atomic) before iterating, or a
+        # mid-scrape chunk completion raises dict-changed-size and the
+        # busiest replica goes invisible to the autoscaler
+        backlog += sum(max(0, len(st.req.prompt) - st.offset)
+                       for st in list(self._chunked.values()))
         return self.sched.snapshot(
             active=len(self._active), waiting=waiting,
             chunked=len(self._chunked), max_batch=self.max_batch,
             prefix_hits=self.paged.prefix_hits,
-            prefix_queries=self.paged.prefix_queries)
+            prefix_queries=self.paged.prefix_queries,
+            backlog_tokens=backlog)
 
     def step(self) -> list[GenRequest]:
         """Admit waiting requests, dispatch one decode chunk, retire
@@ -546,14 +600,25 @@ class LLMEngine:
                 pressure=bool(self._waiting))
             self.sched.note_decode_dispatch(chunk_len)
             self._rng, step_rng = jax.random.split(self._rng)
-            toks, lps, next_tok, self.cache = self._decode(
-                self.params, token_in, self.cache, jnp.asarray(tab),
-                jnp.asarray(active_mask), jnp.asarray(temp),
-                jnp.asarray(top_k), jnp.asarray(top_p), step_rng,
-                # static: an all-greedy batch skips the per-step
-                # full-vocab sort (two compile variants total)
-                greedy_only=not bool((temp > 0).any()),
-                kernel=self.kernel, chunk_len=chunk_len)
+            # static: an all-greedy batch skips the per-step full-vocab
+            # sort (two compile variants total)
+            greedy_only = not bool((temp > 0).any())
+            if (self._compiled_decode is not None and greedy_only
+                    and chunk_len == self.decode_chunk):
+                # the precompile()d executable (depot fast path): same
+                # program as the jitted call below, acquired without a
+                # cold compile on a scale-up replica
+                toks, lps, next_tok, self.cache = self._compiled_decode(
+                    self.params, token_in, self.cache, jnp.asarray(tab),
+                    jnp.asarray(active_mask), jnp.asarray(temp),
+                    jnp.asarray(top_k), jnp.asarray(top_p), step_rng)
+            else:
+                toks, lps, next_tok, self.cache = self._decode(
+                    self.params, token_in, self.cache, jnp.asarray(tab),
+                    jnp.asarray(active_mask), jnp.asarray(temp),
+                    jnp.asarray(top_k), jnp.asarray(top_p), step_rng,
+                    greedy_only=greedy_only,
+                    kernel=self.kernel, chunk_len=chunk_len)
             new_inflight = {
                 "toks": toks, "lps": lps, "next": next_tok,
                 "chunk_len": chunk_len,

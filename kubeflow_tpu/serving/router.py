@@ -1,12 +1,28 @@
-"""Graph router + traffic splitter — the reference's InferenceGraph router
-and Knative revision traffic split (SURVEY.md §2.4) as one in-process router
-that can front either local Models or remote InferenceClients.
+"""Graph router, traffic splitter and the FLEET router — request routing
+for one replica set (the reference's InferenceGraph router and Knative
+revision traffic split, SURVEY.md §2.4) plus the multi-replica layer:
+prefix-affine consistent-hash load balancing so the radix prefix cache
+(serving/paged_kv.py) keeps hitting when one model serves from N replicas.
+
+Why prefix-affine: the radix cache keys KV blocks by token tuples, so two
+requests only share if the SAME replica saw both. Random/least-loaded
+routing dilutes every shared prefix N ways (each replica pays its own cold
+miss for each tenant's system prompt); hashing on the prompt's leading
+radix-block key sends all sharers of a prefix to one replica, preserving
+the single-replica hit rate. Bounded-load spill (the "power of
+consistent-hashing with bounded loads" rule) caps the hot-prefix downside:
+when the affine replica's queue depth exceeds a threshold, the request
+walks to the next distinct node on the ring instead of queueing behind the
+hot spot.
 """
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import random
-from typing import Callable, Union
+import threading
+from typing import Callable, Optional, Union
 
 from kubeflow_tpu.serving.model import Model, ModelRepository
 from kubeflow_tpu.serving.protocol import InferRequest, InferResponse
@@ -24,6 +40,219 @@ def _call(backend: Backend, request: InferRequest) -> InferResponse:
     if isinstance(backend, InferenceClient):
         return backend.infer(request)
     return backend(request)
+
+
+def _hash64(data: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(data.encode()).digest()[:8], "big")
+
+
+def _stable_unit(request_id) -> float:
+    """Deterministic uniform [0, 1) draw from a request id — the sticky
+    half of the canary split: the SAME request (a client retry mid-
+    rollout) must land on the SAME revision, not re-flip the coin."""
+    return _hash64(f"req:{request_id}") / float(1 << 64)
+
+
+def radix_block_key(prompt, block_size: int) -> tuple:
+    """The prompt's leading radix-block key — the token tuple of its first
+    FULL KV block, exactly the tuple ``RadixPrefixCache`` keys that block's
+    node by (prompts shorter than one block key on what they have). Two
+    prompts share cached prefix blocks only if these keys are equal, so
+    this is the affinity unit fleet routing hashes on."""
+    n = min(len(prompt), int(block_size))
+    return tuple(int(t) for t in prompt[:n])
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes. Adding/removing one node
+    moves ~1/N of the key space and NOTHING else — the property that keeps
+    a scale-up from flushing every replica's prefix cache at once."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: list[tuple[int, str]] = []      # sorted (hash, node)
+        self._nodes: set[str] = set()
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def nodes(self) -> set:
+        return set(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for v in range(self.vnodes):
+            bisect.insort(self._points, (_hash64(f"{node}#{v}"), node))
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._points = [p for p in self._points if p[1] != node]
+
+    def walk(self, key) -> list[str]:
+        """Distinct nodes in ring order starting at ``key``'s position:
+        element 0 is the affine owner, the rest are the bounded-load
+        spill order."""
+        if not self._points:
+            return []
+        h = _hash64(f"key:{key!r}")
+        i = bisect.bisect_right(self._points, (h, "￿"))
+        seen: list[str] = []
+        for j in range(len(self._points)):
+            node = self._points[(i + j) % len(self._points)][1]
+            if node not in seen:
+                seen.append(node)
+                if len(seen) == len(self._nodes):
+                    break
+        return seen
+
+    def lookup(self, key) -> Optional[str]:
+        order = self.walk(key)
+        return order[0] if order else None
+
+
+class FleetRouter:
+    """Routes requests across model replicas, prefix-affine by default.
+
+    ``pick(prompt)`` consistent-hashes the prompt's leading radix-block
+    key onto the replica ring and returns the chosen replica name; when
+    the affine replica's load (``load_of(name, backend)`` — queue depth by
+    default) exceeds ``spill_queue_depth``, the request spills to the next
+    ring node under the threshold (counted). When EVERY node is over the
+    threshold the request stays on its affine replica (counted
+    separately): bounded load protects against skew — one hot prefix
+    drowning a replica while others idle — but under global saturation
+    spilling buys no latency and would shred every tenant's cache
+    affinity; that counter rising is the autoscaler's cue that the fleet
+    is undersized, not misrouted.
+
+    ``policy="random"`` is the ablation baseline the bench contrasts
+    against: uniform routing, which dilutes every shared prefix N ways.
+    """
+
+    def __init__(self, *, block_size: int = 16, policy: str = "affine",
+                 spill_queue_depth: int = 4, vnodes: int = 64,
+                 load_of: Optional[Callable] = None, seed: int = 0):
+        if policy not in ("affine", "random"):
+            raise ValueError(f"policy={policy!r} (want affine|random)")
+        self.block_size = int(block_size)
+        self.policy = policy
+        self.spill_queue_depth = int(spill_queue_depth)
+        self.ring = HashRing(vnodes)
+        self.replicas: dict[str, Backend] = {}
+        self.load_of = load_of or self._default_load
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # counters (snapshot() exports them into bench JSON / tests)
+        self.routed = 0
+        self.spills = 0              # affine node over threshold, walked on
+        self.spill_saturated = 0     # every node over threshold: least-loaded
+        self.random_routes = 0
+        self.routes_by_replica: dict[str, int] = {}
+
+    @staticmethod
+    def _default_load(name: str, backend) -> float:
+        """Queue depth of a replica: engine-backed replicas report their
+        scheduler queue; opaque backends read as unloaded (no spill)."""
+        eng = getattr(backend, "engine", backend)
+        stats = getattr(eng, "scheduler_stats", None)
+        if stats is None:
+            return 0.0
+        snap = stats()
+        return float(snap.get("queue_depth", 0)
+                     + snap.get("chunked_in_flight", 0))
+
+    # ------------------------------------------------------- membership --
+
+    def add_replica(self, name: str, backend: Backend = None) -> None:
+        with self._lock:
+            self.replicas[name] = backend
+            self.ring.add(name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self.replicas.pop(name, None)
+            self.ring.remove(name)
+
+    # ------------------------------------------------------------ route --
+
+    def pick(self, prompt, request_id=None) -> str:
+        """Replica name for ``prompt``. Raises when the fleet is empty."""
+        with self._lock:
+            if not self.replicas:
+                raise ValueError("fleet has no replicas")
+            if self.policy == "random":
+                if request_id is not None:
+                    names = sorted(self.replicas)
+                    name = names[int(_stable_unit(request_id)
+                                     * len(names)) % len(names)]
+                else:
+                    name = self._rng.choice(sorted(self.replicas))
+                spilled = saturated = False
+            else:
+                # membership snapshot under the lock; the (possibly
+                # blocking — HTTP on real fleets) load probes run OUTSIDE
+                # it, so one slow replica never serializes all routing
+                order = self.ring.walk(
+                    radix_block_key(prompt, self.block_size))
+                backends = {n: self.replicas[n] for n in order}
+        if self.policy == "random":
+            pass
+        else:
+            name, spilled, saturated = self._pick_affine(order, backends)
+        with self._lock:
+            self.routed += 1
+            if self.policy == "random":
+                self.random_routes += 1
+            if spilled:
+                self.spills += 1
+            if saturated:
+                self.spill_saturated += 1
+            self.routes_by_replica[name] = (
+                self.routes_by_replica.get(name, 0) + 1)
+        return name
+
+    def _pick_affine(self, order, backends):
+        """-> (name, spilled, saturated). Loads are probed LAZILY: the
+        common no-spill case touches only the affine owner's load, not
+        one probe per replica per request."""
+        for i, name in enumerate(order):
+            if self.load_of(name, backends[name]) \
+                    <= self.spill_queue_depth:
+                return name, i > 0, False
+        # every replica over threshold (global saturation, not skew):
+        # stay affine — spilling would shred cache affinity for zero
+        # latency win. Counted: this rising is the scale-up cue.
+        return order[0], False, True
+
+    def route(self, request: InferRequest, prompt) -> InferResponse:
+        """pick + call, for callers fronting real backends. A replica
+        removed between pick and call (concurrent scale-down) re-picks
+        onto the surviving fleet instead of failing the request."""
+        name = None
+        for _ in range(2):
+            name = self.pick(prompt, request_id=request.id)
+            with self._lock:
+                backend = self.replicas.get(name)
+            if backend is not None:
+                return _call(backend, request)
+        raise KeyError(f"replica {name!r} vanished during routing")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "replicas": sorted(self.replicas),
+                "routed": self.routed,
+                "spills": self.spills,
+                "spill_saturated": self.spill_saturated,
+                "random_routes": self.random_routes,
+                "routes_by_replica": dict(self.routes_by_replica),
+            }
 
 
 class GraphRouter:
@@ -102,35 +331,54 @@ class GraphRouter:
 
     def _splitter(self, node: GraphNode, request: InferRequest
                   ) -> InferResponse:
-        total = sum(s.weight for s in node.steps)
-        pick = self._rng.uniform(0, total)
-        acc = 0.0
-        for step in node.steps:
-            acc += step.weight
-            if pick <= acc:
-                return self._step(step, request)
-        return self._step(node.steps[-1], request)
+        # sticky-deterministic canary split: a request WITH an id hashes
+        # onto the weight line (a retry mid-rollout keeps its revision);
+        # only id-less requests draw from the seeded RNG
+        steps = [(s, s.weight) for s in node.steps]
+        step = _pick_weighted(steps, request.id, self._rng)
+        return self._step(step, request)
+
+
+def _pick_weighted(items, request_id, rng: random.Random):
+    """One weighted pick shared by the graph splitter and the revision
+    splitter: deterministic on ``request_id`` when present, seeded-RNG
+    otherwise. All-zero (or negative) total weight is a configuration
+    error and raises — silently routing such traffic to the last entry
+    hid dead canaries."""
+    weights = [(item, max(0.0, float(w))) for item, w in items]
+    total = sum(w for _, w in weights)
+    if total <= 0:
+        raise ValueError("traffic split has no positive weights")
+    u = (_stable_unit(request_id) if request_id is not None
+         else rng.random())
+    pick = u * total
+    acc = 0.0
+    last_live = None
+    for item, w in weights:
+        if w <= 0:
+            continue                 # a zero-weight step can never win
+        last_live = item
+        acc += w
+        if pick <= acc:
+            return item
+    return last_live                 # float-accumulation edge at pick≈total
 
 
 class TrafficSplitter:
     """Revision-level traffic split for canary rollout: routes a request to
     one of the revisions' backends per the InferenceService status traffic
-    map (the ServingController maintains the map; this enforces it)."""
+    map (the ServingController maintains the map; this enforces it).
+    ``request_id`` makes the pick sticky-deterministic — the same request
+    retried mid-rollout cannot flip revisions."""
 
     def __init__(self, seed: int = 0):
         self._rng = random.Random(seed)
 
-    def pick(self, traffic: dict[int, int]) -> int:
+    def pick(self, traffic: dict[int, int], request_id=None) -> int:
         if not traffic:
             raise ValueError("no traffic targets")
-        total = sum(traffic.values())
-        pick = self._rng.uniform(0, total)
-        acc = 0.0
-        for revision, weight in sorted(traffic.items()):
-            acc += weight
-            if pick <= acc:
-                return revision
-        return max(traffic)
+        return _pick_weighted(sorted(traffic.items()), request_id,
+                              self._rng)
 
 
 def serve_repository(repository: ModelRepository) -> dict[str, Backend]:

@@ -38,6 +38,15 @@ Env contract (all optional except the uri for real weights):
   KFT_SPEC_K                 max draft tokens per verify step (default 4)
   KFT_SPEC_DRAFTER           drafter name (default "ngram" =
                              prompt-lookup, zero extra weights)
+  KFT_DEPOT                  executable depot (dir path or operator http
+                             URL, parallel/depot.py): load() acquires the
+                             steady-state decode program depot-first, so
+                             a fleet scale-up replica deserializes what
+                             replica #1 published instead of compiling
+  KFT_DEPOT_CACHE            pod-local depot cache dir — the warm pool
+                             pre-fetches entries into it at claim time
+                             (the ISVC controller suffixes it per pod)
+  KFT_DEPOT_TOKEN            http depot fence (operator-injected)
 """
 
 from __future__ import annotations

@@ -180,10 +180,13 @@ class StepScheduler:
 
     def snapshot(self, *, active: int, waiting: int, chunked: int,
                  max_batch: int, prefix_hits: int,
-                 prefix_queries: int) -> dict:
-        """The /metrics view: occupancy, queue depth, prefix-hit and
-        preempt counters — the signals the serving controller (ROADMAP
-        item 2) autoscales and prefix-affine-routes on."""
+                 prefix_queries: int, backlog_tokens: int = 0) -> dict:
+        """The /metrics view: occupancy, queue depth, token backlog,
+        prefix-hit and preempt counters — the signals the serving
+        controller autoscales and prefix-affine-routes on (the
+        ``kft_model_sched_*`` family the fleet Autoscaler consumes).
+        ``backlog_tokens``: prompt + budget tokens of queued work the
+        replica has admitted responsibility for but not yet scheduled."""
         occ = active / max_batch if max_batch else 0.0
         rate = prefix_hits / prefix_queries if prefix_queries else 0.0
         return {
@@ -201,6 +204,7 @@ class StepScheduler:
             "occupancy_slots": active,
             "occupancy_ratio": round(occ, 4),
             "queue_depth": waiting,
+            "token_backlog": int(backlog_tokens),
             "chunked_in_flight": chunked,
             "prefix_hit_blocks_total": prefix_hits,
             "prefix_query_blocks_total": prefix_queries,

@@ -28,6 +28,7 @@ _V1_MODEL = re.compile(r"^/v1/models/([^/:]+)$")
 _V2_INFER = re.compile(r"^/v2/models/([^/:]+)/infer$")
 _V2_MODEL = re.compile(r"^/v2/models/([^/:]+)$")
 _V2_MODEL_READY = re.compile(r"^/v2/models/([^/:]+)/ready$")
+_V2_MODEL_STATS = re.compile(r"^/v2/models/([^/:]+)/stats$")
 _REPO_LOAD = re.compile(r"^/v2/repository/models/([^/:]+)/(load|unload)$")
 
 
@@ -122,6 +123,12 @@ class ModelServer:
                             else:
                                 flat.append((k, v))
                         for k, v in flat:
+                            # numeric gauges only: stats() may carry
+                            # strings (e.g. the depot outcome) for the
+                            # JSON stats endpoint — a non-numeric value
+                            # would corrupt the prometheus exposition
+                            if not isinstance(v, (int, float, bool)):
+                                continue
                             text += (f'kft_model_{k}'
                                      f'{{model="{mname}"}} {v}\n')
                     body = text.encode()
@@ -135,6 +142,15 @@ class ModelServer:
                 if m:
                     return self._with_model(m.group(1), lambda mod:
                         self._json(200, {"name": mod.name, "ready": mod.ready}))
+                m = _V2_MODEL_STATS.match(path)
+                if m:
+                    # JSON view of the model's stats() families (sched
+                    # signals, depot outcome): what the fleet autoscaler
+                    # and router scrape without parsing prometheus text
+                    return self._with_model(m.group(1), lambda mod:
+                        self._json(200, {
+                            "name": mod.name,
+                            **(getattr(mod, "stats", dict)() or {})}))
                 m = _V2_MODEL.match(path)
                 if m:
                     return self._with_model(m.group(1), lambda mod:

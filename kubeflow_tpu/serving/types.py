@@ -55,6 +55,18 @@ class ServingRuntime:
 
 
 @dataclasses.dataclass
+class CanarySLO:
+    """Promotion gate for a canary revision (serving/controller.CanaryGate
+    consumes it): promote once ``min_requests`` canary outcomes stayed
+    within the error-rate (and optional p95 latency) budget; roll back the
+    moment the error budget is provably burned."""
+
+    max_error_rate: float = 0.02
+    max_p95_latency_s: float = 0.0      # 0 = don't gate on latency
+    min_requests: int = 20
+
+
+@dataclasses.dataclass
 class PredictorSpec:
     model_format: ModelFormat = dataclasses.field(
         default_factory=lambda: ModelFormat("jax"))
@@ -62,9 +74,15 @@ class PredictorSpec:
     runtime: Optional[str] = None       # explicit ServingRuntime name
     min_replicas: int = 1
     max_replicas: int = 1
-    scale_metric: str = "concurrency"
+    # "sched" (default) = the per-replica kft_model_sched_* family (queue
+    # depth / token backlog / occupancy — what the fleet Autoscaler
+    # consumes; pods exporting none fall back to the in-flight probe);
+    # "concurrency" pins the legacy in-flight probe. scale_target is
+    # slots (or in-flight requests) per replica either way.
+    scale_metric: str = "sched"
     scale_target: int = 8
     canary_traffic_percent: Optional[int] = None   # % to the LATEST revision
+    canary_slo: Optional[CanarySLO] = None         # SLO-gated promotion
     tpu: Optional[TPUSpec] = None
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     # LLM runtimes only: step-scheduler knobs, stamped onto the predictor
@@ -124,8 +142,11 @@ def inference_service_from_dict(d: dict) -> InferenceService:
     sched = p.pop("scheduler", None)
     if isinstance(sched, dict):
         sched = SchedulerPolicy(**sched)
+    slo = p.pop("canary_slo", None)
+    if isinstance(slo, dict):
+        slo = CanarySLO(**slo)
     predictor = PredictorSpec(model_format=fmt, tpu=tpu, scheduler=sched,
-                              **p)
+                              canary_slo=slo, **p)
     return InferenceService(
         name=d["name"], namespace=d.get("namespace", "default"),
         labels=dict(d.get("labels", {})), predictor=predictor)

@@ -1,0 +1,728 @@
+"""Multi-replica serving fleet (ISSUE 12): consistent-hash ring
+stability, prefix-affine routing with bounded-load spill, the
+sticky-deterministic canary split, scheduler-signal autoscaling with
+hysteresis, the serving-vs-train warm-claim race, SLO-gated canary
+promote/rollback, and the depot-backed decode precompile.
+
+The invariants here are the ones that rot a fleet silently: a ring that
+reshuffles more than 1/N keys on scale-up flushes every replica's prefix
+cache at once; a retried request that flips canary revisions corrupts the
+error-budget measurement; an autoscaler that flaps evicts warm replicas
+the next burst needs; a claim race with two winners runs two workers on
+one zygote.
+"""
+
+import collections
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.controller.cluster import FakeCluster, Pod, PodPhase
+from kubeflow_tpu.serving.controller import (
+    Autoscaler, CanaryGate, RuntimeRegistry, ServingController,
+    ServingTicker,
+)
+from kubeflow_tpu.serving.router import (
+    FleetRouter, HashRing, TrafficSplitter, radix_block_key,
+)
+from kubeflow_tpu.serving.types import (
+    InferenceService, ModelFormat, PredictorSpec, ServingRuntime,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ ring --
+
+def _keys(n=1000):
+    return [(i, i + 1, i + 2) for i in range(n)]
+
+
+def test_ring_add_moves_at_most_one_nth_of_keys():
+    ring = HashRing(vnodes=64)
+    for r in ("r0", "r1", "r2", "r3"):
+        ring.add(r)
+    before = {k: ring.lookup(k) for k in _keys()}
+    ring.add("r4")
+    after = {k: ring.lookup(k) for k in _keys()}
+    moved = [k for k in before if before[k] != after[k]]
+    # expectation 1/5 with vnode variance; anything near a full reshuffle
+    # (hash-mod-N behavior) would land at ~4/5
+    assert len(moved) / len(before) < 0.35, len(moved)
+    # the STRONG property: every moved key moved TO the new node — keys
+    # between surviving replicas never reshuffle among themselves
+    assert all(after[k] == "r4" for k in moved)
+
+
+def test_ring_remove_only_moves_the_removed_nodes_keys():
+    ring = HashRing(vnodes=64)
+    for r in ("r0", "r1", "r2"):
+        ring.add(r)
+    before = {k: ring.lookup(k) for k in _keys()}
+    ring.remove("r1")
+    for k, owner in before.items():
+        if owner != "r1":
+            assert ring.lookup(k) == owner
+        else:
+            assert ring.lookup(k) in ("r0", "r2")
+
+
+def test_radix_block_key_matches_radix_cache_scheme():
+    """The affinity key IS the radix tree's first-block key: equal keys
+    <=> shareable first block."""
+    from kubeflow_tpu.serving.paged_kv import RadixPrefixCache
+
+    cache = RadixPrefixCache(block_size=4)
+    prompt = [5, 6, 7, 8, 9, 10]
+    assert radix_block_key(prompt, 4) == cache._keys(prompt)[0]
+    # shorter than a block: keys on what exists (no full block to share,
+    # but equal short prompts still co-locate)
+    assert radix_block_key([5, 6], 4) == (5, 6)
+
+
+# ------------------------------------------------------------- spill --
+
+def _router(loads, spill=4):
+    r = FleetRouter(block_size=4, spill_queue_depth=spill,
+                    load_of=lambda n, b: loads[n])
+    for n in loads:
+        r.add_replica(n)
+    return r
+
+
+def test_bounded_load_spills_past_overloaded_affine_replica():
+    loads = {"a": 0.0, "b": 0.0, "c": 0.0}
+    r = _router(loads)
+    key = [1, 2, 3, 4]
+    primary = r.ring.walk(radix_block_key(key, 4))[0]
+    assert r.pick(key) == primary
+    loads[primary] = 99.0
+    spilled = r.pick(key)
+    assert spilled != primary
+    # deterministic: the NEXT ring node, not an arbitrary one
+    assert spilled == r.ring.walk(radix_block_key(key, 4))[1]
+    assert r.spills == 1
+
+
+def test_global_saturation_stays_affine():
+    """When EVERY replica is over threshold, spilling shreds cache
+    affinity for zero latency win — the pick stays on the affine owner
+    and the saturation counter (the scale-up cue) rises instead."""
+    loads = {"a": 99.0, "b": 99.0, "c": 99.0}
+    r = _router(loads)
+    key = [1, 2, 3, 4]
+    primary = r.ring.walk(radix_block_key(key, 4))[0]
+    assert r.pick(key) == primary
+    assert r.spill_saturated == 1 and r.spills == 0
+
+
+def test_same_prefix_spills_land_together():
+    """Bounded-load spill keeps tenant cohesion: every request of a
+    prefix whose affine replica is hot spills to the SAME next node, so
+    the prefix is paid once there, not scattered."""
+    loads = {"a": 0.0, "b": 0.0, "c": 0.0}
+    r = _router(loads, spill=2)
+    key = [9, 9, 9, 9]
+    primary = r.ring.walk(radix_block_key(key, 4))[0]
+    loads[primary] = 10.0
+    picks = {r.pick(key + [i]) for i in range(20)}
+    assert len(picks) == 1 and primary not in picks
+
+
+def test_fleet_router_random_policy_and_empty_fleet():
+    r = FleetRouter(block_size=4, policy="random", seed=3)
+    with pytest.raises(ValueError):
+        r.pick([1, 2, 3])
+    for n in ("a", "b"):
+        r.add_replica(n)
+    picks = {r.pick([1, 2, 3, 4], request_id=i) for i in range(50)}
+    assert picks == {"a", "b"}
+    # deterministic per request id even under the random policy
+    assert len({r.pick([1, 2, 3, 4], request_id=7) for _ in range(10)}) == 1
+
+
+# --------------------------------------------------------- sticky split --
+
+def test_traffic_splitter_sticky_on_request_id():
+    sp = TrafficSplitter(seed=1)
+    picks = {sp.pick({1: 50, 2: 50}, request_id="req-x") for _ in range(50)}
+    assert len(picks) == 1
+    # sticky across splitter INSTANCES (a retry may hit another router)
+    sp2 = TrafficSplitter(seed=99)
+    assert sp2.pick({1: 50, 2: 50}, request_id="req-x") in picks
+
+
+def test_traffic_splitter_zero_weight_edges():
+    sp = TrafficSplitter(seed=1)
+    with pytest.raises(ValueError):
+        sp.pick({1: 0, 2: 0})
+    # a zero-weight revision can never win, id-hashed or not
+    assert all(sp.pick({1: 0, 2: 100}, request_id=str(i)) == 2
+               for i in range(100))
+    assert all(sp.pick({1: 0, 2: 100}) == 2 for i in range(100))
+
+
+def test_traffic_splitter_id_distribution_matches_weights():
+    sp = TrafficSplitter()
+    picks = collections.Counter(
+        sp.pick({1: 80, 2: 20}, request_id=f"r{i}") for i in range(2000))
+    assert 0.7 < picks[1] / 2000 < 0.9
+
+
+def test_graph_splitter_sticky_and_zero_weight():
+    from kubeflow_tpu.serving.protocol import InferRequest, InferTensor
+    from kubeflow_tpu.serving.router import GraphRouter
+    from kubeflow_tpu.serving.types import (
+        GraphNode, GraphNodeType, GraphStep, InferenceGraph,
+    )
+    import numpy as np
+
+    seen = []
+
+    def backend(tag):
+        def fn(req):
+            seen.append(tag)
+            from kubeflow_tpu.serving.protocol import InferResponse
+
+            return InferResponse.from_numpy(tag, {"y": req.as_numpy()})
+        return fn
+
+    graph = InferenceGraph(name="g", nodes={
+        "root": GraphNode(GraphNodeType.SPLITTER, steps=[
+            GraphStep(service="old", weight=50),
+            GraphStep(service="new", weight=50),
+        ])})
+    router = GraphRouter(graph, {"old": backend("old"),
+                                 "new": backend("new")})
+
+    def req(rid):
+        return InferRequest(model_name="g", inputs=[
+            InferTensor.from_numpy("x", np.ones((1, 1), np.float32))],
+            id=rid)
+
+    for _ in range(10):
+        router.route(req("sticky-1"))
+    assert len(set(seen)) == 1          # same id -> same revision, always
+
+    graph0 = InferenceGraph(name="g", nodes={
+        "root": GraphNode(GraphNodeType.SPLITTER, steps=[
+            GraphStep(service="old", weight=0),
+            GraphStep(service="new", weight=0),
+        ])})
+    router0 = GraphRouter(graph0, {"old": backend("old"),
+                                   "new": backend("new")})
+    with pytest.raises(ValueError):
+        router0.route(req("r"))
+
+
+# ---------------------------------------------------------- autoscaler --
+
+def _isvc(min_r=1, max_r=8, target=4, name="m"):
+    return InferenceService(name=name, predictor=PredictorSpec(
+        min_replicas=min_r, max_replicas=max_r, scale_target=target))
+
+
+def test_autoscaler_consumes_sched_signals():
+    sc = Autoscaler(idle_grace_seconds=0.0,
+                    backlog_tokens_per_replica=1024)
+    isvc = _isvc()
+    # slot demand: occupied + queued at scale_target per replica
+    sig = [{"occupancy_slots": 4, "queue_depth": 8, "token_backlog": 0}]
+    assert sc.scale(isvc, signals=sig, now=0, current=1) == 3
+    # token backlog scales up even when queue_depth is shallow (few, long
+    # prompts)
+    sig = [{"occupancy_slots": 0, "queue_depth": 1, "token_backlog": 5000}]
+    assert sc.scale(isvc, signals=sig, now=1, current=3) == 5
+    # multi-replica signals aggregate
+    sig = [{"occupancy_slots": 4, "queue_depth": 2},
+           {"occupancy_slots": 4, "queue_depth": 2}]
+    assert sc.scale(isvc, signals=sig, now=2, current=5) == 3
+
+
+def test_autoscaler_scale_down_hysteresis():
+    """Satellite: no flapping — scale down only after idle_grace_seconds
+    of SUSTAINED low signal, never below min_replicas."""
+    sc = Autoscaler(idle_grace_seconds=10.0)
+    isvc = _isvc(min_r=2, max_r=8, target=4)
+    up = [{"occupancy_slots": 8, "queue_depth": 8}]
+    low = [{"occupancy_slots": 1, "queue_depth": 0}]
+    assert sc.scale(isvc, signals=up, now=0.0, current=2) == 4   # up: now
+    assert sc.scale(isvc, signals=low, now=1.0, current=4) == 4  # hold
+    assert sc.scale(isvc, signals=low, now=9.0, current=4) == 4  # hold
+    # one busy blip RESTARTS the window
+    assert sc.scale(isvc, signals=up, now=10.0, current=4) == 4
+    assert sc.scale(isvc, signals=low, now=12.0, current=4) == 4
+    assert sc.scale(isvc, signals=low, now=23.0, current=4) == 2
+    # never below min_replicas, however idle
+    assert sc.scale(isvc, signals=[{}], now=100.0, current=2) == 2
+
+
+def test_autoscaler_never_scales_down_mid_canary():
+    sc = Autoscaler(idle_grace_seconds=0.0)
+    isvc = _isvc()
+    isvc.status.ready_revision, isvc.status.latest_revision = 1, 2
+    low = [{"occupancy_slots": 0, "queue_depth": 0}]
+    assert sc.scale(isvc, signals=low, now=0.0, current=4) == 4
+    # split resolved: the (elapsed) window applies again
+    isvc.status.latest_revision = 1
+    assert sc.scale(isvc, signals=low, now=1.0, current=4) == 1
+
+
+def test_autoscaler_scale_to_zero_never_collapses_a_canary():
+    sc = Autoscaler(idle_grace_seconds=0.0)
+    isvc = InferenceService(name="z", predictor=PredictorSpec(
+        min_replicas=0, max_replicas=3, scale_target=4))
+    isvc.status.ready_revision, isvc.status.latest_revision = 1, 2
+    low = [{"occupancy_slots": 0, "queue_depth": 0}]
+    assert sc.scale(isvc, signals=low, now=100.0, current=2) == 2
+    # split resolved: zero is reachable again
+    isvc.status.latest_revision = 1
+    assert sc.scale(isvc, signals=low, now=101.0, current=2) == 0
+
+
+def test_autoscaler_legacy_concurrency_and_scale_to_zero():
+    """The pre-fleet contract still holds (ticker falls back to it for
+    pods with no scheduler family)."""
+    sc = Autoscaler(idle_grace_seconds=10)
+    isvc0 = InferenceService(name="z", predictor=PredictorSpec(
+        min_replicas=0, max_replicas=3, scale_target=4))
+    assert sc.scale(isvc0, 4, now=0.0) == 1
+    assert sc.scale(isvc0, 0, now=5.0) == 1      # within grace
+    assert sc.scale(isvc0, 0, now=20.0) == 0     # zero: own grace clock
+
+
+def test_ticker_scales_on_injected_sched_signals():
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(name="rt",
+                                supported_formats=[ModelFormat("jax")]))
+    ctl = ServingController(cluster, reg)
+    sig = {"v": [{"occupancy_slots": 0, "queue_depth": 0}]}
+    ticker = ServingTicker(ctl, Autoscaler(idle_grace_seconds=0.0),
+                           signals_of=lambda isvc: sig["v"])
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"), min_replicas=1, max_replicas=4,
+        scale_target=4, scale_metric="sched")))
+    for (ns, name), pod in list(cluster.pods.items()):
+        cluster.set_phase(ns, pod.name, PodPhase.RUNNING)
+    ticker.tick()
+
+    def predictors():
+        return [p for p in cluster.pods.values()
+                if p.labels.get("component") == "predictor"]
+
+    assert len(predictors()) == 1
+    sig["v"] = [{"occupancy_slots": 8, "queue_depth": 6,
+                 "token_backlog": 900}]
+    ticker.tick()
+    assert len(predictors()) == 4                # ceil(14/4) = 4
+    sig["v"] = [{"occupancy_slots": 0, "queue_depth": 0}] * 4
+    ticker.tick()
+    ticker.tick()
+    assert len(predictors()) == 1                # grace 0: down again
+
+
+# ------------------------------------------------- claim race (serving) --
+
+ZYGOTE_CMD = [sys.executable, "-m", "kubeflow_tpu.rendezvous.zygote",
+              "tcp://127.0.0.1:0"]
+
+
+@pytest.fixture()
+def kube():
+    from kubeflow_tpu.controller import FakeKubeApiServer, KubeCluster
+
+    srv = FakeKubeApiServer().start()
+    yield KubeCluster(srv.url)
+    srv.stop()
+
+
+class _StubZygote:
+    """Protocol-faithful zygote stand-in (no jax import)."""
+
+    def __init__(self, hold_s=0.5):
+        self.requests = []
+        self.hold_s = hold_s
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.addr = "127.0.0.1:%d" % self._srv.getsockname()[1]
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            self.requests.append(json.loads(buf))
+            conn.sendall(json.dumps({"pid": 4242}).encode() + b"\n")
+            time.sleep(self.hold_s)
+            conn.sendall(json.dumps({"exit": 0}).encode() + b"\n")
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+
+def _serving_pod(name="llm-predictor-rev1-1"):
+    return Pod(name=name, namespace="default",
+               labels={"isvc": "llm", "component": "predictor",
+                       "revision": "1"},
+               env={"KFT_BIND": "127.0.0.1:9999"},
+               command=[sys.executable, "-m",
+                        "kubeflow_tpu.serving.runtime"], gang=False)
+
+
+def _train_pod(name="j-worker-0"):
+    return Pod(name=name, namespace="default",
+               labels={"job-name": "j", "job-uid": "u1",
+                       "replica-type": "Worker", "replica-index": "0"},
+               env={"KFT_PROCESS_ID": "0"},
+               command=[sys.executable, "-m", "some.worker"], gang=True)
+
+
+def test_serving_predictor_pods_are_claim_eligible():
+    from kubeflow_tpu.controller import WarmPoolController
+
+    pool = WarmPoolController.__new__(WarmPoolController)
+    assert pool.eligible(_serving_pod())
+    assert pool.eligible(_train_pod())
+    # a storage-initializer predictor must cold-start (the zygote only
+    # execs the main command)
+    init = _serving_pod()
+    init.init_command = [sys.executable, "-m",
+                         "kubeflow_tpu.serving.runtime", "--init-only"]
+    assert not pool.eligible(init)
+    # transformers/explainers keep their own lifecycle
+    other = _serving_pod()
+    other.labels["component"] = "transformer"
+    assert not pool.eligible(other)
+
+
+def test_serving_scaleup_races_train_claim_one_winner(kube):
+    """Satellite: a fleet scale-up and a train-job admission race for the
+    LAST standby — the CAS label patch lets exactly one win; the loser
+    cold-falls-back, counted. Serving and HPO/train sharing one pool is
+    the co-tenancy story, so the race MUST stay single-winner across pod
+    kinds."""
+    from kubeflow_tpu.controller import WarmPoolController
+    from kubeflow_tpu.controller.warmpool import (
+        POOL_CLASS_LABEL, POOL_STATE_LABEL, ZYGOTE_ADDR_ANNOTATION,
+    )
+
+    stub = _StubZygote()
+    pod = Pod(name="kft-warm-default-0", namespace="default",
+              labels={POOL_CLASS_LABEL: "default",
+                      POOL_STATE_LABEL: "standby"},
+              env={}, command=list(ZYGOTE_CMD), gang=False)
+    kube.create_pod(pod)
+    kube.set_phase("default", pod.name, PodPhase.RUNNING)
+    kube.patch_pod("default", pod.name, {"metadata": {"annotations": {
+        ZYGOTE_ADDR_ANNOTATION: stub.addr}}})
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def claim(tag, job_pod):
+        barrier.wait()
+        results[tag] = pool.claim_and_exec(job_pod)
+
+    ts = [threading.Thread(target=claim, args=("serving", _serving_pod())),
+          threading.Thread(target=claim, args=("train", _train_pod()))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    won = [tag for tag, r in results.items() if r is not None]
+    assert len(won) == 1, results
+    assert pool.claims == 1 and pool.fallbacks == 1
+    assert len(stub.requests) == 1
+    doc = kube._request("GET", kube._pod_path("default", pod.name))
+    labels = doc["metadata"]["labels"]
+    assert labels[POOL_STATE_LABEL] == "claimed"
+    if won[0] == "serving":
+        assert labels["component"] == "predictor"
+    else:
+        assert labels["job-name"] == "j"
+
+
+def test_claim_eligible_serving_pod_created_gated(kube):
+    """A predictor pod that will try a warm claim is POSTed gated even
+    though it is not a gang pod: an ungated manifest would let the
+    kubelet cold-spawn the twin in the create->claim window (two
+    processes racing one bind)."""
+    from kubeflow_tpu.controller import WarmPoolController
+
+    pool = WarmPoolController(kube, size=0, command=ZYGOTE_CMD)
+    kube.warm_pool = pool
+    pod = _serving_pod(name="gated-pred-0")
+    kube.create_pod(pod)
+    doc = kube._request("GET", kube._pod_path("default", "gated-pred-0"))
+    assert doc["spec"].get("schedulingGates"), "claim-eligible pod ungated"
+    # dry pool: admission falls back cold and LIFTS the gate
+    kube.start_pod(pod)
+    doc = kube._request("GET", kube._pod_path("default", "gated-pred-0"))
+    assert not doc["spec"].get("schedulingGates")
+    assert pool.fallbacks == 1
+
+
+def test_scaledown_with_failed_pod_gaps_removes_high_indices():
+    """Regression: excess replicas above a gap of failed/deleted indices
+    are still scaled down (the scan bound covers max_replicas, not just
+    the live-pod count)."""
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(name="rt",
+                                supported_formats=[ModelFormat("jax")]))
+    ctl = ServingController(cluster, reg)
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"), min_replicas=1, max_replicas=4)))
+    ctl.set_scale("default", "m", 4)
+    _ready_all(cluster)
+    for i in (1, 2):
+        cluster.set_phase("default", f"m-predictor-rev1-{i}",
+                          PodPhase.FAILED, exit_code=1)
+    ctl.set_scale("default", "m", 1)
+    names = sorted(p.name for p in cluster.pods.values()
+                   if p.labels.get("component") == "predictor")
+    assert names == ["m-predictor-rev1-0"]
+
+
+def test_scaledown_of_claimed_replica_converges(kube):
+    """Regression: a scale-up replica claimed from a standby that
+    PRE-DATES the service (the production ordering) must scale back down
+    without churn — deletion goes by index identity through the claim
+    alias, so reconcile never deletes a pod it immediately recreates."""
+    from kubeflow_tpu.controller import WarmPoolController
+    from kubeflow_tpu.controller.warmpool import (
+        POOL_CLASS_LABEL, POOL_STATE_LABEL, ZYGOTE_ADDR_ANNOTATION,
+    )
+
+    stub = _StubZygote(hold_s=30.0)
+    standby = Pod(name="kft-warm-default-0", namespace="default",
+                  labels={POOL_CLASS_LABEL: "default",
+                          POOL_STATE_LABEL: "standby"},
+                  env={}, command=list(ZYGOTE_CMD), gang=False)
+    kube.create_pod(standby)          # created BEFORE the service
+    kube.set_phase("default", standby.name, PodPhase.RUNNING)
+    kube.patch_pod("default", standby.name, {"metadata": {"annotations": {
+        ZYGOTE_ADDR_ANNOTATION: stub.addr}}})
+
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(
+        name="rt", supported_formats=[ModelFormat("llama")],
+        command=[sys.executable, "-m", "kubeflow_tpu.serving.runtime"]))
+    ctl = ServingController(kube, reg)
+    # replica 0 starts cold (no pool yet), like a fleet whose pool warmed
+    # later than its first replica
+    ctl.apply(InferenceService(name="llm", predictor=PredictorSpec(
+        model_format=ModelFormat("llama"), min_replicas=1,
+        max_replicas=2)))
+    kube.run_scheduled()
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    kube.warm_pool = pool
+    ctl.set_scale("default", "llm", 2)        # replica 1 claims the standby
+    assert pool.claims == 1
+
+    def predictor_names():
+        return sorted(p.name for p in kube.list_pods(
+            "default", {"isvc": "llm", "component": "predictor"}))
+
+    assert predictor_names() == ["kft-warm-default-0",
+                                 "llm-predictor-rev1-0"]
+    ctl.set_scale("default", "llm", 1)        # down: the CLAIMED one goes
+    assert predictor_names() == ["llm-predictor-rev1-0"]
+    # convergence, not churn: further reconciles change nothing
+    ctl.reconcile("default", "llm")
+    ctl.reconcile("default", "llm")
+    assert predictor_names() == ["llm-predictor-rev1-0"]
+
+
+# -------------------------------------------------------------- canary --
+
+def _canary_cluster():
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(name="rt",
+                                supported_formats=[ModelFormat("jax")]))
+    ctl = ServingController(cluster, reg)
+    return cluster, ctl
+
+
+def _ready_all(cluster):
+    for (ns, name), pod in list(cluster.pods.items()):
+        if pod.phase == PodPhase.PENDING:
+            cluster.set_phase(ns, name, PodPhase.RUNNING)
+
+
+def test_canary_gate_rollback_on_error_budget_burn():
+    """Satellite: injected error burn rolls the canary back through the
+    ticker — traffic returns to the ready revision, canary pods drop."""
+    cluster, ctl = _canary_cluster()
+    ticker = ServingTicker(ctl, autoscaler=None,
+                           signals_of=lambda isvc: [])
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"))))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert ctl.get("default", "m").status.ready_revision == 1
+
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"), canary_traffic_percent=30,
+        env={"NEW": "1"})))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert ctl.get("default", "m").status.traffic == {2: 30, 1: 70}
+
+    gate = CanaryGate(max_error_rate=0.05, min_requests=20)
+    ticker.attach_canary("default", "m", gate)
+    ticker.tick()                      # not enough data: split stays
+    assert ctl.get("default", "m").status.traffic == {2: 30, 1: 70}
+    for _ in range(3):                 # 3 errors: budget provably burned
+        gate.observe(False)
+    ticker.tick()
+    st = ctl.get("default", "m").status
+    assert st.traffic == {1: 100}
+    revs = {p.labels["revision"] for p in cluster.pods.values()}
+    assert revs == {"1"}
+
+
+def test_canary_slo_spec_auto_arms_gate_and_promotes():
+    """The API path: PredictorSpec.canary_slo alone drives the rollout —
+    the ticker auto-arms a gate once the split is live, the data plane
+    feeds it via canary_gate(), and the SLO pass promotes."""
+    from kubeflow_tpu.serving.types import CanarySLO
+
+    cluster, ctl = _canary_cluster()
+    ticker = ServingTicker(ctl, autoscaler=None,
+                           signals_of=lambda isvc: [])
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"))))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"), canary_traffic_percent=50,
+        env={"NEW": "1"},
+        canary_slo=CanarySLO(max_error_rate=0.1, max_p95_latency_s=5.0,
+                             min_requests=10))))
+    _ready_all(cluster)
+    ticker.tick()                      # split live -> gate auto-armed
+    gate = ticker.canary_gate("default", "m")
+    assert gate is not None
+    ticker.tick()                      # no data yet: split stays
+    assert ctl.get("default", "m").status.traffic == {2: 50, 1: 50}
+    for _ in range(10):
+        gate.observe(True, 0.01)
+    ticker.tick()
+    st = ctl.get("default", "m").status
+    assert st.traffic == {2: 100} and st.ready_revision == 2
+    # verdict enacted: the gate is disarmed, not reused next rollout
+    assert ticker.canary_gate("default", "m") is None
+
+
+def test_stale_canary_gate_dropped_after_manual_resolution():
+    """A gate left over from a split resolved manually must not decide
+    the NEXT rollout with the old revision's observations."""
+    cluster, ctl = _canary_cluster()
+    ticker = ServingTicker(ctl, autoscaler=None,
+                           signals_of=lambda isvc: [])
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"))))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"), canary_traffic_percent=50,
+        env={"NEW": "1"})))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    gate = CanaryGate(max_error_rate=0.1, min_requests=5)
+    ticker.attach_canary("default", "m", gate)
+    for _ in range(5):
+        gate.observe(True, 0.01)       # would promote if consulted
+    ctl.promote("default", "m")        # operator resolves it MANUALLY
+    ticker.tick()                      # split gone: stale gate dropped
+    assert ticker.canary_gate("default", "m") is None
+    # rollout 2: a fresh split must not inherit the old observations
+    ctl.apply(InferenceService(name="m", predictor=PredictorSpec(
+        model_format=ModelFormat("jax"), canary_traffic_percent=50,
+        env={"NEW": "2"})))
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    ticker.tick()
+    st = ctl.get("default", "m").status
+    assert st.latest_revision == 3 and st.traffic.get(3) == 50
+
+
+def test_canary_gate_latency_slo():
+    g = CanaryGate(max_error_rate=0.5, max_p95_latency_s=0.1,
+                   min_requests=5)
+    for _ in range(5):
+        g.observe(True, 1.0)
+    assert g.decide() == "rollback"
+
+
+# ------------------------------------------------------ depot precompile --
+
+def test_engine_precompile_depot_roundtrip(tmp_path):
+    """The serving half of the compile-once story: engine #1 publishes
+    its decode executable; engine #2 (a scale-up replica) fetches and
+    deserializes it — and both generate token-identically to a plain
+    jitted engine."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel.depot import DepotStats, DirectoryDepot
+    from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(1), cfg, dtype=jnp.float32)
+    depot = DirectoryDepot(str(tmp_path / "depot"))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, 12).tolist()
+               for _ in range(4)]
+
+    def engine():
+        return LLMEngine(params, cfg, max_batch=4, max_seq=64,
+                         prefill_buckets=(16,), decode_chunk=4)
+
+    ref = engine().generate(prompts, SamplingParams(max_tokens=8))
+    st1 = DepotStats()
+    e1 = engine()
+    assert e1.precompile(depot=depot, stats=st1) == "published"
+    out1 = e1.generate(prompts, SamplingParams(max_tokens=8))
+    st2 = DepotStats()
+    e2 = engine()
+    assert e2.precompile(depot=depot, stats=st2) == "hit"
+    assert st2.get("compiles") == 0
+    out2 = e2.generate(prompts, SamplingParams(max_tokens=8))
+    assert ([r.generated for r in out1] == [r.generated for r in out2]
+            == [r.generated for r in ref])
+    # a corrupt entry degrades to a counted compile, never a failure
+    key = depot.keys()[0]
+    depot.put(key, b"garbage", replace=True)
+    st3 = DepotStats()
+    e3 = engine()
+    assert e3.precompile(depot=depot, stats=st3) in ("published",
+                                                     "compiled")
+    assert st3.get("deserialize_failures") == 1
+    out3 = e3.generate(prompts, SamplingParams(max_tokens=8))
+    assert [r.generated for r in out3] == [r.generated for r in ref]
