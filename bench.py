@@ -130,6 +130,7 @@ def main():
     parity = _kernel_parity(on_tpu)
     submit_latency = _submit_to_first_step_bench()
     kube_latency = _kube_latency_bench()
+    recovery = _recovery_bench()
     proofs = _scale_proofs()
     proj_8b = _project_8b_decode_v5p8(serve.get("roofline") or {})
 
@@ -161,6 +162,12 @@ def main():
             # fake apiserver + image-less kubelet, cold pod vs a CLAIMED
             # pre-warmed zygote pod, phases over the heartbeat transport
             "submit_to_first_step_kube": kube_latency,
+            # elastic recovery (ROADMAP item 5): chaos kills a training
+            # worker mid-run on the kube rig; recovery_seconds =
+            # kill -> first post-resume step, decomposed detect / claim /
+            # load / rendezvous / first_step_after, with depot_outcome
+            # and loss-curve continuity vs an uninterrupted run
+            "recovery": recovery,
             # VERDICT r5 Missing #2: the serving north-star config
             # (Llama-3-8B on v5p-8/TP=4) projected analytically from the
             # decode roofline, calibrated by this run's measured v5e gap
@@ -1653,6 +1660,261 @@ def _kube_latency_bench() -> dict:
         cleanup()
 
 
+def _decompose_recovery(ph: dict, t_kill: float, t_detect: float) -> dict:
+    """Replacement-worker phase stamps + controller detection timestamp ->
+    the recovery_seconds decomposition. Phases (all measured, none
+    modeled): detect (kill -> the reconciler observes the failure), claim
+    (detection -> the replacement process is alive: reconcile + warm-pool
+    claim + zygote fork + backoff), rendezvous (world re-formed), load
+    (imports + state init + checkpoint restore + executable-depot load —
+    the depot makes this a deserialize, not a compile), first_step_after
+    (the first post-resume training step)."""
+    out = {
+        "detect": t_detect - t_kill,
+        "claim": ph["proc_start"] - t_detect,
+        "rendezvous": ph["rendezvous_done"] - ph["imports_done"],
+        "load": (ph["imports_done"] - ph["proc_start"])
+        + (ph["compile_done"] - ph["rendezvous_done"]),
+        "first_step_after": ph["first_step_done"] - ph["compile_done"],
+    }
+    out["recovery_seconds"] = ph["first_step_done"] - t_kill
+    return {k: round(v, 3) for k, v in out.items()}
+
+
+def _recovery_bench() -> dict:
+    """Elastic-recovery scenario on the kube rig (fake apiserver +
+    image-less kubelet + warm pool + depot + REAL worker processes):
+    train a 1-worker job with periodic checkpoints, chaos-SIGKILL its
+    process out of the kubelet's process table mid-run, and measure the
+    operator-driven warm replacement — detection via the kubelet's
+    terminal report, a warm-pool claim whose pre-fetch carries the depot
+    entry, checkpoint resume at the exact step, and loss-curve
+    continuity against an uninterrupted baseline run of the same
+    program. ``recovery_seconds`` is decomposed by phase; the acceptance
+    contract (--recovery-smoke) requires depot_outcome=hit (no cold
+    compile anywhere on the replacement path), a per-worker replacement
+    (NOT a counted gang restart), and post-resume losses exactly equal
+    to the baseline's."""
+    import os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.api.types import RestartPolicy, jax_job
+    from kubeflow_tpu.controller import (
+        FakeKubeApiServer, FakeKubelet, FaultInjector, JobController,
+        KubeCluster, Operator, WarmPoolController,
+    )
+    from kubeflow_tpu.controller.cluster import PodPhase
+    from kubeflow_tpu.training.metrics import read_metrics
+
+    tmp = tempfile.mkdtemp(prefix="kft-bench-recovery-")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base_env = {
+        "PYTHONPATH": repo + ":" + os.environ.get("PYTHONPATH", ""),
+        "KFT_FORCE_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    srv = op = kubelet = None
+
+    def cleanup():
+        try:
+            if op is not None:
+                op.stop()
+        finally:
+            if kubelet is not None:
+                kubelet.stop()
+            if srv is not None:
+                srv.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    try:
+        srv = FakeKubeApiServer().start()
+        kube = KubeCluster(srv.url)
+        pool = WarmPoolController(
+            kube, size=1, reap_s=600.0, env=dict(base_env),
+            command=[sys.executable, "-m",
+                     "kubeflow_tpu.rendezvous.zygote", "tcp://127.0.0.1:0"])
+        ctl = JobController(kube)
+        op = Operator(ctl, heartbeat_dir=os.path.join(tmp, "hb"),
+                      heartbeat_period=0.1, reconcile_slow_period=0.2,
+                      serving_period=0.2, warm_pool=pool)
+        op.start(port=0)
+        kubelet = FakeKubelet(srv.url, log_dir=os.path.join(tmp, "pods"))
+        kubelet.start()
+        chaos = FaultInjector(kube, kubelet=kubelet)
+    except Exception as e:                    # never sink the bench line
+        cleanup()
+        return {"error": f"{type(e).__name__}: {e}"}
+
+    steps = 8
+    ckpt_every = 2
+    cmd = [sys.executable, "-m", "kubeflow_tpu.rendezvous.worker_check"]
+
+    def worker_env(tag, extra=None):
+        env = {**base_env,
+               "KFT_TRAIN_STEPS": str(steps),
+               "KFT_METRICS_PATH": os.path.join(tmp, f"{tag}.jsonl"),
+               "KFT_COMPILE_CACHE": os.path.join(tmp, "xla-cache"),
+               "KFT_DEPOT_CACHE": os.path.join(tmp, f"depot-cache-{tag}")}
+        env.update(extra or {})
+        return env
+
+    def losses(tag):
+        out = {}
+        for r in read_metrics(os.path.join(tmp, f"{tag}.jsonl")):
+            if "loss" in r:
+                out[int(r["step"])] = r["loss"]
+        return out
+
+    def wait_warm(timeout_s=120.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if any(kubelet.wait_announced(p.namespace, p.name,
+                                          timeout_s=0.2)
+                   for p in pool._pool_pods("default", "standby") if p):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def wait_finished(name, timeout_s=240.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            job = ctl.get("default", name)
+            if job is not None and job.status.is_finished():
+                return job
+            time.sleep(0.2)
+        return ctl.get("default", name)
+
+    try:
+        if not wait_warm():
+            return {"error": "no standby zygote within 120s"}
+        # uninterrupted baseline: the reference loss curve; its one real
+        # compile also PUBLISHES the train-step executable to the depot
+        op.submit(jax_job("rec-base", workers=1, mesh={"data": 1},
+                          command=cmd, env=worker_env("base")))
+        base_job = wait_finished("rec-base")
+        if base_job is None or base_job.status.condition().value \
+                != "Succeeded":
+            return {"error": "baseline run did not succeed",
+                    "condition": str(
+                        base_job and base_job.status.condition())}
+        base_losses = losses("base")
+        if not wait_warm():
+            return {"error": "pool never replenished before the kill"}
+
+        # victim: checkpoints every 2 steps, paced so the kill lands
+        # mid-run with a finalized checkpoint behind it
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        job = jax_job("rec-victim", workers=1, mesh={"data": 1},
+                      command=cmd,
+                      env=worker_env("victim", {
+                          "KFT_CHECKPOINT_DIR": ckpt_dir,
+                          "KFT_CHECKPOINT_EVERY": str(ckpt_every),
+                          "KFT_STEP_SLEEP": "0.6"}))
+        job.replica_specs["Worker"].restart_policy = RestartPolicy.EXIT_CODE
+        op.submit(job)
+
+        def checkpointed():
+            try:
+                entries = os.listdir(ckpt_dir)
+            except OSError:
+                return False
+            return any(d.isdigit() for d in entries) and not any(
+                "tmp" in d for d in entries)
+
+        deadline = time.time() + 180
+        while time.time() < deadline and not (
+                checkpointed() and losses("victim").get(4) is not None):
+            time.sleep(0.05)
+        if losses("victim").get(4) is None:
+            return {"error": "victim never reached step 4"}
+
+        pool_before = pool.snapshot()
+        t_kill = time.time()
+        if not chaos.kill_pod("default", "rec-victim-worker-0"):
+            return {"error": "chaos found no live victim process"}
+
+        done = wait_finished("rec-victim")
+        if done is None or not done.status.is_finished():
+            return {"error": "victim job never finished after the kill"}
+        if done.status.condition().value != "Succeeded":
+            return {"error": "victim job failed after the kill",
+                    "worker_replacements": done.status.worker_replacements,
+                    "restart_count": done.status.restart_count}
+
+        # ---- join the recovery timeline with the replacement's stamps --
+        events = op.job_recovery("default", "rec-victim")
+        t_detect = next((e["t"] for e in events
+                         if e["event"] == "worker_failed"
+                         and e["t"] >= t_kill), None)
+        replaced = [e for e in events if e["event"] == "replacement"]
+        gang_restarts = [e for e in events if e["event"] == "gang_restart"]
+        repl_phases = None
+        for pod_name_, ph in op.job_phases("default", "rec-victim").items():
+            if "restore_done" in ph and "first_step_done" in ph:
+                repl_phases = ph
+        out = {
+            "workers": 1,
+            "steps": steps,
+            "checkpoint_every": ckpt_every,
+            "backend": ("KubeCluster + fake apiserver + image-less "
+                        "kubelet + warm pool + depot"),
+            "worker_replacements": done.status.worker_replacements,
+            "gang_restarts": len(gang_restarts),
+            "recovery_events": [
+                {k: (round(v, 3) if isinstance(v, float) else v)
+                 for k, v in e.items()} for e in events],
+        }
+        if t_detect is None or repl_phases is None or not replaced:
+            out["error"] = "incomplete recovery timeline"
+            return out
+        out.update(_decompose_recovery(repl_phases, t_kill, t_detect))
+        out["phases"] = {k: out.pop(k) for k in
+                         ("detect", "claim", "rendezvous", "load",
+                          "first_step_after")}
+        out["resumed_from_step"] = repl_phases.get("resumed_from_step")
+        out["depot_outcome"] = ("hit" if repl_phases.get("depot_hit")
+                                else "miss")
+        # warm claim accounting across the recovery window: the
+        # replacement must have CLAIMED (not cold-fallen-back)
+        pool_after = pool.snapshot()
+        out["replacement_warm_claims"] = (
+            pool_after["claims"] - pool_before["claims"])
+        out["replacement_cold_fallbacks"] = (
+            pool_after["fallbacks"] - pool_before["fallbacks"])
+        out["warm_pool"] = pool_after
+        # loss-curve continuity: every post-resume step must EXACTLY
+        # match the uninterrupted baseline (checkpoint-exact state +
+        # step-indexed data stream + buffer-laundered restore)
+        victim_losses = losses("victim")
+        resumed = int(repl_phases.get("resumed_from_step", -1))
+        compared, mismatched = 0, []
+        for step_, loss_ in sorted(victim_losses.items()):
+            if step_ > resumed and step_ in base_losses:
+                compared += 1
+                if loss_ != base_losses[step_]:
+                    mismatched.append(
+                        {"step": step_, "victim": loss_,
+                         "baseline": base_losses[step_]})
+        out["loss_continuity"] = {
+            "resumed_from": resumed,
+            "steps_compared": compared,
+            "exact": not mismatched and compared > 0,
+            "mismatched": mismatched,
+        }
+        out["note"] = (
+            "CPU rig: the DECOMPOSITION is the signal — detect/claim "
+            "ride controller ticks, load is imports+restore+depot "
+            "deserialize (no compile), first_step_after excludes the "
+            "KFT_STEP_SLEEP pacing of later steps")
+        return out
+    except Exception as e:                    # never sink the bench line
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        cleanup()
+
+
 def _scale_proofs() -> list:
     """AOT per-chip HBM proofs for the BASELINE configs this chip can't
     run (8B serving on v5p-8; 70B FSDP on 2-slice v5p-128); ~3 min of
@@ -1786,6 +2048,39 @@ def fleet_smoke_main():
     return 0 if ok else 1
 
 
+def recovery_smoke_main():
+    """``bench.py --recovery-smoke``: ONLY the elastic-recovery scenario
+    (CPU, CI-runnable, ~90s) as one JSON line — the `make test-elastic`
+    acceptance entry point. Exits nonzero unless a REAL
+    kill→warm-claim→resume cycle completed: a per-worker replacement
+    (zero gang restarts), depot_outcome=hit with a warm claim and no
+    cold fallback on the replacement path, the full recovery_seconds
+    phase decomposition in the JSON, and post-resume losses exactly
+    matching the uninterrupted baseline."""
+    out = _recovery_bench()
+    print(json.dumps({
+        "metric": "recovery_seconds",
+        "value": out.get("recovery_seconds"),
+        "unit": "s",
+        "extra": out,
+    }))
+    cont = out.get("loss_continuity") or {}
+    phases = out.get("phases") or {}
+    ok = ("error" not in out
+          and out.get("worker_replacements", 0) >= 1
+          and out.get("gang_restarts", 1) == 0
+          and out.get("depot_outcome") == "hit"
+          and out.get("replacement_warm_claims", 0) >= 1
+          and out.get("replacement_cold_fallbacks", 1) == 0
+          and out.get("recovery_seconds") is not None
+          and all(k in phases for k in
+                  ("detect", "claim", "load", "rendezvous",
+                   "first_step_after"))
+          and cont.get("exact") is True
+          and cont.get("steps_compared", 0) >= 1)
+    return 0 if ok else 1
+
+
 def kube_main():
     """``bench.py --cluster kube``: ONLY the kube-backend warm-pool
     latency bench (CPU-safe, CI-runnable) as one JSON line — the make
@@ -1834,6 +2129,13 @@ if __name__ == "__main__":
                          "replicas served, a warm-claim scale-up "
                          "happened, and per-replica hit-rate + "
                          "scale-latency fields are in the JSON)")
+    ap.add_argument("--recovery-smoke", action="store_true",
+                    help="only the elastic-recovery scenario on the kube "
+                         "rig (CI smoke; nonzero exit unless a real "
+                         "kill→warm-claim→resume cycle completed with "
+                         "depot_outcome=hit, zero gang restarts, the "
+                         "phase decomposition, and exact loss-curve "
+                         "continuity)")
     cli = ap.parse_args()
     if cli.serving_smoke:
         sys.exit(serving_smoke_main())
@@ -1841,4 +2143,6 @@ if __name__ == "__main__":
         sys.exit(spec_smoke_main())
     if cli.fleet_smoke:
         sys.exit(fleet_smoke_main())
+    if cli.recovery_smoke:
+        sys.exit(recovery_smoke_main())
     sys.exit(kube_main() if cli.cluster == "kube" else main())

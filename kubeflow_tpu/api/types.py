@@ -141,6 +141,17 @@ class JobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     restart_count: int = 0
+    # elastic recovery (per-worker replacement instead of whole-gang
+    # restart): total warm replacements performed for this job, per-worker
+    # replacement counts (the per-worker backoff/budget accounting — keys
+    # are job pod identities like "job-worker-1"), and the rendezvous
+    # epoch every pod of the CURRENT worker-incarnation carries as
+    # KFT_RENDEZVOUS_EPOCH (bumped on every replacement or gang restart
+    # so survivors and replacements agree on which world they re-form)
+    worker_replacements: int = 0
+    replacement_counts: dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    rendezvous_epoch: int = 0
 
     def condition(self) -> Optional[ConditionType]:
         """Latest *phase* condition — Warning entries are advisory and never
@@ -416,6 +427,14 @@ def to_yaml(job: JobSpec) -> str:
             "startTime": job.status.start_time,
             "completionTime": job.status.completion_time,
         }
+        if job.status.worker_replacements or job.status.rendezvous_epoch:
+            # a restarted controller must keep the per-worker budget and
+            # epoch too, or an adopted flapping worker gets a fresh budget
+            doc["status"]["workerReplacements"] = (
+                job.status.worker_replacements)
+            doc["status"]["rendezvousEpoch"] = job.status.rendezvous_epoch
+            doc["status"]["replacementCounts"] = dict(
+                job.status.replacement_counts)
     return yaml.safe_dump(doc, sort_keys=False)
 
 
@@ -492,6 +511,12 @@ def from_yaml(text: str) -> JobSpec:
         job.status.conditions.append(Condition(
             type=ConditionType(st["condition"]), reason="Restored"))
         job.status.restart_count = int(st.get("restartCount", 0))
+        job.status.worker_replacements = int(st.get("workerReplacements", 0))
+        job.status.rendezvous_epoch = int(st.get("rendezvousEpoch", 0))
+        rc = st.get("replacementCounts")
+        if isinstance(rc, dict):
+            job.status.replacement_counts = {
+                str(k): int(v) for k, v in rc.items()}
         if st.get("startTime") is not None:
             job.status.start_time = float(st["startTime"])
         if st.get("completionTime") is not None:

@@ -47,7 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--warm-pool-size", type=int, default=None,
                        help="pre-warmed standby zygote pods kept per pool "
                             "class on --cluster kube (0 = disabled); "
-                            "admission claims one instead of cold-starting")
+                            "admission claims one instead of cold-starting. "
+                            "On --cluster local any value > 0 enables the "
+                            "daemon-resident zygote (warm forks + the "
+                            "per-worker elastic replacement path)")
     serve.add_argument("--log-dir", default=None)
     serve.add_argument("--state-dir", default=None,
                        help="durable platform state (metadata WAL, HPO "
@@ -128,7 +131,12 @@ def main(argv=None) -> int:
         for job in controller.job_store.load_all():
             controller.restore(job)
     else:
-        cluster = (LocalProcessCluster(log_dir=cfg.log_dir)
+        # local warm pool: the daemon-resident pre-imported zygote. Also
+        # what marks the cluster warm-CAPABLE for the reconciler's
+        # per-worker elastic replacement (a dead worker respawns warm in
+        # place of a whole-gang restart)
+        cluster = (LocalProcessCluster(log_dir=cfg.log_dir,
+                                       warm_pool=cfg.warm_pool_size > 0)
                    if args.cluster == "local" else FakeCluster())
         controller = JobController(cluster)
     controller.scheduler.aging_s = cfg.gang_aging_s

@@ -9,6 +9,18 @@ recovery) drive:
 - LocalProcessCluster: victims get SIGKILL (exit < 0 — what the
   EXIT_CODE restart policy classifies as retryable), exactly the
   slice-preemption signature at scale.
+- KubeCluster: victims die through the same surfaces the kube e2e rig
+  uses — when a FakeKubelet is attached, the pod's REAL process is
+  SIGKILLed out of the kubelet's process table (the kubelet then reports
+  the terminal phase through the apiserver, exactly like a preempted
+  node); without one, the fake apiserver's status subresource plays the
+  kubelet and flips the phase directly. Killing a claimed warm-pool
+  standby kills its resident zygote, which takes the forked worker with
+  it (PDEATHSIG) — the preemption signature for warm pods.
+
+``max_kills`` is a hard budget enforced under a lock: concurrent
+scheduled-kill ticks and direct ``kill_pod`` calls reserve a slot before
+touching a victim, so the blast radius can never overshoot by a race.
 """
 
 from __future__ import annotations
@@ -16,6 +28,7 @@ from __future__ import annotations
 import random
 import signal
 import threading
+import time
 from typing import Optional
 
 from kubeflow_tpu.controller.cluster import (
@@ -24,26 +37,77 @@ from kubeflow_tpu.controller.cluster import (
 
 
 class FaultInjector:
-    """Kill pods of a cluster, one-shot or on a background schedule."""
+    """Kill pods of a cluster, one-shot or on a background schedule.
 
-    def __init__(self, cluster, seed: int = 0):
+    ``kubelet``: the image-less node agent backing a KubeCluster rig
+    (controller/kubelet.py) — when given, kube kills go through its real
+    process table instead of a status PATCH.
+    """
+
+    def __init__(self, cluster, seed: int = 0, kubelet=None):
         self.cluster = cluster
+        self.kubelet = kubelet
         self.rng = random.Random(seed)
         self.kills: list[tuple[str, str]] = []     # (namespace, pod name)
+        self.max_kills: Optional[int] = None
+        self._lock = threading.Lock()
+        self._reserved = 0          # kill slots handed out (budget fence)
+        # victims currently being killed: two concurrent kill_pod calls
+        # on the SAME pod must not both commit (one death, one budget
+        # slot). Entries live only for the kill's duration — a respawned
+        # pod under the same name is a fresh, killable victim.
+        self._in_flight: set[tuple[str, str]] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    # ------------------------------------------------------------- one-shot
+    # --------------------------------------------------------- budget --
+
+    def _reserve_kill(self) -> bool:
+        with self._lock:
+            if self.max_kills is not None \
+                    and self._reserved >= self.max_kills:
+                return False
+            self._reserved += 1
+            return True
+
+    def _commit_kill(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self.kills.append((namespace, name))
+
+    def _release_kill(self) -> None:
+        with self._lock:
+            self._reserved -= 1
+
+    # ------------------------------------------------------- one-shot --
 
     def kill_pod(self, namespace: str, name: str) -> bool:
         """Fail one pod the way a preempted TPU host fails. Returns whether
-        a live victim was actually hit."""
+        a live victim was actually hit; respects the ``max_kills`` budget
+        even under concurrent callers, and never double-commits one death
+        (the loser of a same-victim race reports False)."""
+        victim = (namespace, name)
+        with self._lock:
+            if victim in self._in_flight:
+                return False
+            self._in_flight.add(victim)
+        try:
+            if not self._reserve_kill():
+                return False
+            if self._kill_pod(namespace, name):
+                self._commit_kill(namespace, name)
+                return True
+            self._release_kill()
+            return False
+        finally:
+            with self._lock:
+                self._in_flight.discard(victim)
+
+    def _kill_pod(self, namespace: str, name: str) -> bool:
         if isinstance(self.cluster, LocalProcessCluster):
             proc = self.cluster.procs.get((namespace, name))
             if proc is None or proc.poll() is not None:
                 return False
             proc.send_signal(signal.SIGKILL)
-            self.kills.append((namespace, name))
             return True
         if isinstance(self.cluster, FakeCluster):
             pod = self.cluster.get_pod(namespace, name)
@@ -52,7 +116,32 @@ class FaultInjector:
                 return False
             self.cluster.set_phase(namespace, name, PodPhase.FAILED,
                                    exit_code=-9)
-            self.kills.append((namespace, name))
+            return True
+        from kubeflow_tpu.controller.kube import KubeApiError, KubeCluster
+
+        if isinstance(self.cluster, KubeCluster):
+            pod = self.cluster.get_pod(namespace, name)
+            if pod is None or pod.phase not in (PodPhase.PENDING,
+                                                PodPhase.RUNNING):
+                return False
+            # the pod may be served by a claimed warm standby under its
+            # own name — kill the process that ACTUALLY backs it
+            victim = (pod.namespace, pod.name)
+            proc = (self.kubelet.procs.get(victim)
+                    if self.kubelet is not None else None)
+            if proc is not None and proc.poll() is None:
+                # real preemption: SIGKILL the node-local process; the
+                # kubelet's next sync reports FAILED with a signal exit
+                # code through the apiserver — the full detection path
+                proc.send_signal(signal.SIGKILL)
+                return True
+            # no node agent (envtest-style rig): play the kubelet via the
+            # status subresource, like FakeCluster.set_phase
+            try:
+                self.cluster.set_phase(pod.namespace, pod.name,
+                                       PodPhase.FAILED, exit_code=-9)
+            except (KubeApiError, OSError):
+                return False
             return True
         raise TypeError(f"unsupported cluster {type(self.cluster).__name__}")
 
@@ -68,18 +157,38 @@ class FaultInjector:
                 return pod.name
         return None
 
-    # ------------------------------------------------------------ schedule
+    def wait_for_kill(self, n: int = 1, timeout_s: float = 30.0) -> bool:
+        """Block until at least ``n`` kills landed (bench/test barrier)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if len(self.kills) >= n:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # ------------------------------------------------------ schedule --
 
     def start(self, namespace: str, selector: Optional[dict] = None, *,
               period_s: float = 1.0, kill_probability: float = 1.0,
               max_kills: Optional[int] = None) -> None:
         """Background chaos: every ``period_s``, with ``kill_probability``,
-        kill one random matching pod, up to ``max_kills`` victims."""
+        kill one random matching pod, up to ``max_kills`` victims (the
+        budget also binds concurrent direct ``kill_pod`` calls)."""
+        with self._lock:
+            self.max_kills = max_kills
 
         def loop():
             while not self._stop.wait(period_s):
-                if max_kills is not None and len(self.kills) >= max_kills:
-                    return
+                with self._lock:
+                    # exit on COMMITTED kills only: transient in-flight
+                    # reservations (a concurrent kill_pod mid-check that
+                    # may yet release its slot) must not end scheduled
+                    # chaos below budget — the reserve fence alone stops
+                    # overshoot
+                    if max_kills is not None \
+                            and len(self.kills) >= max_kills:
+                        return
                 if self.rng.random() <= kill_probability:
                     self.kill_random(namespace, selector)
 

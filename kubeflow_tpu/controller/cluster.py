@@ -61,6 +61,11 @@ class Pod:
     image: str = ""
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
     resources: dict[str, str] = dataclasses.field(default_factory=dict)
+    # server-side annotations mirror (kube backend): mutable metadata that
+    # changes at runtime — late-bound env, zygote address, the elastic
+    # restart-epoch signal the kubelet acts on. Backends without an
+    # apiserver leave it empty.
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -159,6 +164,25 @@ class FakeCluster:
     def resolve(self, namespace, service):
         svc = self.services[(namespace, service)]
         return f"{service}.{namespace}.svc:{svc.port}"
+
+    def restart_pod_process(self, namespace: str, name: str,
+                            env_updates: Optional[dict] = None) -> bool:
+        """Re-rendezvous signal (elastic recovery): restart the pod's
+        process IN PLACE — the pod object, its labels, and its scheduling
+        survive. In-memory pods have no process; the env update and the
+        event record are what tests assert."""
+        pod = self.pods.get((namespace, name))
+        if pod is None or pod.phase not in (PodPhase.PENDING,
+                                            PodPhase.RUNNING):
+            return False
+        pod.env.update(env_updates or {})
+        # the pod's PROCESS incarnation restarted now: created_at is what
+        # heartbeat staleness measures startup grace from, and the old
+        # incarnation's last beat must read as "never beat yet", not as a
+        # 60s-stale beat that insta-fails the survivor mid-recovery
+        pod.created_at = time.time()
+        self.events.append(f"restart_pod_process {name}")
+        return True
 
     # -- test helpers (the 'kubelet' role) --
     def set_phase(self, namespace, name, phase, exit_code=None):
@@ -470,6 +494,64 @@ class LocalProcessCluster:
         else:
             with self._lock:
                 _launch()
+
+    def restart_pod_process(self, namespace: str, name: str,
+                            env_updates: Optional[dict] = None) -> bool:
+        """Re-rendezvous signal (elastic recovery): kill the pod's process
+        and start a fresh one IN the same pod — name, labels, gang
+        admission, log file, and node-local caches all survive; only the
+        process (and so its jax.distributed world membership) is new. The
+        restarted process forks from the zygote when eligible, so the
+        survivor's bounce is warm too."""
+        with self._lock:
+            key = (namespace, name)
+            pod = self.pods.get(key)
+            proc = self.procs.pop(key, None)
+            if pod is None or proc is None:
+                if proc is not None:        # pod gone: don't leak the proc
+                    self.procs[key] = proc
+                return False
+            pod.env.update(env_updates or {})
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        env = dict(os.environ)
+        env.update(pod.env)
+        log_path = os.path.join(self.log_dir, f"{pod.name}.log")
+        log = open(log_path, "ab")
+        log.write(b"restart_pod_process: re-rendezvous signal\n")
+        log.flush()
+        with self._lock:
+            if key not in self.pods:        # deleted while we were killing
+                log.close()
+                return False
+            # new process incarnation: restart the heartbeat grace clock
+            # (see FakeCluster.restart_pod_process)
+            pod.created_at = time.time()
+            proc = None
+            if self.warm_pool and zygote_eligible(pod.command):
+                proc = self._zygote_spawn(pod, dict(pod.env), log_path)
+            if proc is not None:
+                log.close()
+            else:
+                if self.warm_pool:
+                    self.zygote_fallbacks += 1
+                try:
+                    proc = subprocess.Popen(
+                        pod.command or [sys.executable, "-c", "pass"],
+                        env=env, stdout=log, stderr=subprocess.STDOUT)
+                except OSError as e:
+                    pod.phase = PodPhase.FAILED
+                    pod.exit_code = -1
+                    log.write(f"restart spawn failed: {e}\n".encode())
+                    log.close()
+                    return False
+            self.procs[key] = proc
+            pod.phase = PodPhase.RUNNING
+            return True
 
     def delete_pod(self, namespace, name):
         key = (namespace, name)

@@ -34,11 +34,17 @@ class FileHeartbeatTracker:
         now = time.time() if now is None else now
         path = self.path_for(job_name, pod_name)
         try:
-            age = now - os.path.getmtime(path)
+            mtime = os.path.getmtime(path)
         except OSError:
             # never beat: stale only after the startup grace window
             return now - pod_started_at > self.startup_grace_s
-        return age > self.timeout_s
+        if mtime < pod_started_at:
+            # the beat predates this pod INCARNATION (a replaced/restarted
+            # worker under the same name): a dead incarnation's last beat
+            # must not fail the fresh pod — it gets the startup grace,
+            # like a pod that never beat
+            return now - pod_started_at > self.startup_grace_s
+        return now - mtime > self.timeout_s
 
 
 def check_heartbeats(controller: JobController, namespace: str, name: str,

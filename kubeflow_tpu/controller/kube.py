@@ -49,6 +49,10 @@ from kubeflow_tpu.controller.cluster import Pod, PodPhase, Service
 
 GANG_GATE = "kubeflow-tpu.org/gang"
 ENV_ANNOTATION_PREFIX = "kubeflow-tpu.org/env."
+# elastic recovery: bumping this annotation tells the node agent to kill
+# and respawn the pod's process IN PLACE (the survivor re-rendezvous
+# signal) — the pod itself, its claim, and its node-local caches survive
+RESTART_EPOCH_ANNOTATION = "kubeflow-tpu.org/restart-epoch"
 # a claimed warm-pool standby pod records WHICH job pod identity it serves
 # (controller/warmpool.py): a restarted controller rebuilds its name-alias
 # map from this annotation alone
@@ -413,6 +417,51 @@ class KubeCluster:
             self._fold(doc)
         return doc
 
+    # --------------------------------------------- elastic recovery --
+
+    def can_restart_in_place(self, pod: Pod) -> bool:
+        """Whether the survivor re-rendezvous signal can reach this pod.
+        Claimed warm-pool standbys run their worker as a zygote FORK the
+        node agent cannot bounce (the claim connection owns its lifetime)
+        — restarting one means killing the zygote, i.e. losing the pod;
+        that forces the counted gang-restart fallback instead."""
+        with self._lock:
+            return (pod.namespace, pod.name) not in set(
+                self._claims.values())
+
+    def restart_pod_process(self, namespace: str, name: str,
+                            env_updates: Optional[dict] = None) -> bool:
+        """Signal an in-place process restart (elastic recovery): bump the
+        restart-epoch annotation (+ publish the new env as annotations);
+        the node agent kills and respawns the pod's process with the
+        merged env. The pod object — claim, labels, scheduling — is
+        untouched."""
+        key = (namespace, name)
+        with self._lock:
+            target = self._claims.get(key)
+        if target is not None:
+            namespace, name = target
+        ann = {RESTART_EPOCH_ANNOTATION:
+               (env_updates or {}).get("KFT_RENDEZVOUS_EPOCH")
+               or str(time.time())}
+        for k, v in (env_updates or {}).items():
+            ann[ENV_ANNOTATION_PREFIX + k] = str(v)
+        try:
+            self.patch_pod(namespace, name,
+                           {"metadata": {"annotations": ann}})
+        except (KubeApiError, OSError):
+            return False
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is not None:
+                pod.env.update(env_updates or {})
+                # new process incarnation: the heartbeat grace clock (and
+                # the incarnation-aware staleness check) key on
+                # created_at — the bounced survivor must get startup
+                # grace, not the old incarnation's stale-beat timeout
+                pod.created_at = time.time()
+        return True
+
     def _apply_remote(self, pod: Pod, doc: dict) -> None:
         try:
             rv = int((doc.get("metadata") or {})
@@ -429,6 +478,12 @@ class KubeCluster:
         except (TypeError, ValueError):
             pass
         phase, exit_code = _manifest_status(doc)
+        ann = (doc.get("metadata") or {}).get("annotations")
+        if ann is not None:
+            # annotations are server truth that changes at runtime (zygote
+            # address, restart-epoch, late-bound env) — mirror them so the
+            # kubelet/consumers see updates, not the creation snapshot
+            pod.annotations = dict(ann)
         labels = (doc.get("metadata") or {}).get("labels")
         if labels is not None:
             # labels are server truth and DO change at runtime here: a
@@ -564,6 +619,7 @@ class KubeCluster:
             init_command=list(
                 (spec.get("initContainers") or [{}])[0].get("command")
                 or []),
+            annotations=dict(meta.get("annotations") or {}),
         )
         pod.scheduled = not spec.get("schedulingGates")
         pod.gang = bool(spec.get("schedulingGates"))

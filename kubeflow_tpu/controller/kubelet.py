@@ -37,7 +37,10 @@ import time
 from typing import Optional
 
 from kubeflow_tpu.controller.cluster import PodPhase
-from kubeflow_tpu.controller.kube import KubeApiError, KubeCluster
+from kubeflow_tpu.controller.kube import (
+    ENV_ANNOTATION_PREFIX, KubeApiError, KubeCluster,
+    RESTART_EPOCH_ANNOTATION,
+)
 from kubeflow_tpu.controller.warmpool import ZYGOTE_ADDR_ANNOTATION
 
 
@@ -55,6 +58,10 @@ class FakeKubelet:
         self._reported: set[tuple[str, str]] = set()    # terminal reported
         self._starting: set[tuple[str, str]] = set()    # init step running
         self._spawned_at: dict[tuple[str, str], float] = {}
+        # restart-epoch each pod's CURRENT process was spawned under; a
+        # newer annotation = the operator's re-rendezvous signal -> bounce
+        self._restart_epochs: dict[tuple[str, str], str] = {}
+        self.restarts = 0               # in-place process restarts served
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(log_dir, exist_ok=True)
@@ -118,19 +125,57 @@ class FakeKubelet:
                 self._reported.discard(key)
                 self._announced.discard(key)
                 self._spawn(pod)
+            self._maybe_restart_in_place(pod, key)
             self._publish_announce(key)
             self._report_exit(key)
         for key in [k for k in list(self.procs) if k not in server]:
             self._kill(self.procs.pop(key))
             self._announced.discard(key)
             self._reported.discard(key)
+            self._restart_epochs.pop(key, None)
         # _starting keys clear themselves when their init thread finishes;
         # a deleted pod's late spawn is reaped by the loop above next pass
+
+    def _maybe_restart_in_place(self, pod, key: tuple[str, str]) -> None:
+        """The operator's re-rendezvous signal (elastic recovery): a
+        bumped restart-epoch annotation on a pod with a live process means
+        'kill and respawn the process, keep the pod' — the survivor's half
+        of per-worker replacement. Env updates ride as annotations and win
+        over the creation-time env."""
+        epoch = (pod.annotations or {}).get(RESTART_EPOCH_ANNOTATION)
+        if epoch is None:
+            return
+        proc = self.procs.get(key)
+        if proc is None or proc.poll() is not None:
+            # no live process to bounce: record the epoch so a later spawn
+            # doesn't immediately re-restart itself
+            self._restart_epochs[key] = epoch
+            return
+        if self._restart_epochs.get(key) == epoch:
+            return
+        self._restart_epochs[key] = epoch
+        self.procs.pop(key, None)       # off the exit reporter FIRST: this
+        self._kill(proc)                # death is ours, not a pod failure
+        self._reported.discard(key)
+        self.restarts += 1
+        with open(self._log_path(key), "ab") as log:
+            log.write(f"kubelet: in-place restart (epoch {epoch})\n"
+                      .encode())
+        self._spawn(pod)
 
     def _spawn(self, pod) -> None:
         key = (pod.namespace, pod.name)
         env = dict(os.environ)
         env.update({k: str(v) for k, v in pod.env.items()})
+        # late-bound annotation env (merged AFTER pod.env: an updated
+        # annotation — e.g. the new rendezvous epoch — must win over the
+        # creation-time value baked into the manifest env fold)
+        for k, v in (pod.annotations or {}).items():
+            if k.startswith(ENV_ANNOTATION_PREFIX):
+                env[k[len(ENV_ANNOTATION_PREFIX):]] = str(v)
+        if RESTART_EPOCH_ANNOTATION in (pod.annotations or {}):
+            self._restart_epochs[key] = pod.annotations[
+                RESTART_EPOCH_ANNOTATION]
         env["KFT_ZYGOTE_ANNOUNCE"] = self._announce_path(key)
         try:
             # a recreated pod must not inherit its predecessor's address

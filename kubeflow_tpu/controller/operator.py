@@ -374,6 +374,18 @@ class Operator:
                     pending += 1
             for phase, n in phases.items():
                 self.metrics.set("kft_jobs", n, {"phase": phase})
+            # elastic-recovery counters (reconciler-side): exported as
+            # real Prometheus counters via deltas, like the warm pool's
+            last = getattr(self, "_recovery_exported", {})
+            for k in ("worker_replacements_total", "gang_restarts_total"):
+                cur = self.controller.metrics.get(k, 0)
+                if cur > last.get(k, 0):
+                    self.metrics.inc(f"kft_{k}", by=cur - last.get(k, 0))
+                last[k] = cur
+            self._recovery_exported = last
+            self.metrics.set(
+                "kft_restart_backoff_seconds",
+                self.controller.metrics.get("restart_backoff_seconds", 0.0))
             self.metrics.set(
                 "kft_gang_queue_depth",
                 sum(1 for g in getattr(self.controller.scheduler, "groups", {})
@@ -524,6 +536,15 @@ class Operator:
                     for (pns, pjob, puid, pod), ph
                     in self.phase_reports.items()
                     if pns == ns and pjob == job_name and puid == uid}
+
+    def job_recovery(self, ns: str, job_name: str) -> list[dict]:
+        """The reconciler's recovery timeline for a job (worker_failed /
+        replacement / survivor_restarted / gang_restart events with
+        timestamps) — what bench.py decomposes recovery_seconds from,
+        joined with the worker phase stamps in ``job_phases``."""
+        with self._lock:
+            return [dict(e) for e in
+                    self.controller.recovery_log.get((ns, job_name), [])]
 
     def _tick_warm_pool(self) -> None:
         """Replenish/reap the warm pool and export its counters — runs on
@@ -748,6 +769,8 @@ def _job_to_dict(job) -> dict:
         "uid": job.uid,
         "condition": cond.value if cond else None,
         "restart_count": job.status.restart_count,
+        "worker_replacements": job.status.worker_replacements,
+        "rendezvous_epoch": job.status.rendezvous_epoch,
         "conditions": [
             {"type": c.type.value, "reason": c.reason, "message": c.message}
             for c in job.status.conditions

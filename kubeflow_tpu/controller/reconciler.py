@@ -10,14 +10,25 @@ with backoff, TTL cleanup. TPU-first differences:
   KFT_NUM_PROCESSES / KFT_PROCESS_ID + KFT_MESH topology), not
   MASTER_ADDR/NCCL (SURVEY.md §2.8). TF_CONFIG is still produced for the
   TFJob-compat kind.
-- Failure domain is the whole slice: any worker failure triggers a gang
-  restart (delete ALL pods, re-admit) because ICI collectives cannot survive
-  a member loss; recovery is checkpoint-resume (SURVEY.md §5).
+- Failure domain is ELASTIC (per-worker replacement first, whole-gang
+  restart as the counted fallback): when a worker dies and the cluster has
+  warm capacity (``cluster.warm_pool``), the reconciler deletes ONLY the
+  dead pod, stamps the replacement with the dead worker's rank/rendezvous
+  env under a new worker-incarnation id (gang reservation and job uid
+  preserved), and signals surviving pods to re-rendezvous in place —
+  training resumes from the latest checkpoint at the exact step. The
+  whole-slice gang restart (delete ALL pods, re-admit) remains for the
+  cases where ICI/rendezvous structure really is lost: the coordinator
+  (global rank 0 of a multi-process world) died, no standby is claimable,
+  survivors cannot be restarted in place, or a worker exhausted its
+  per-worker replacement budget. Both paths apply exponential backoff
+  with jitter between attempts (counted, visible in job conditions).
 """
 
 from __future__ import annotations
 
 import json
+import random
 import time
 import uuid
 from typing import Optional
@@ -60,11 +71,33 @@ class JobController:
     `submit`/`get`/`delete` mutate the job store, `reconcile` converges it."""
 
     def __init__(self, cluster: Cluster, scheduler: Optional[GangScheduler] = None,
-                 pod_mutator=None):
+                 pod_mutator=None, *,
+                 restart_backoff_base_s: float = 1.0,
+                 restart_backoff_cap_s: float = 60.0,
+                 restart_backoff_jitter: float = 0.2):
         self.cluster = cluster
         self.scheduler = scheduler or GangScheduler()
         self.jobs: dict[tuple[str, str], JobSpec] = {}
         self.metrics: dict[str, float] = {}   # controller-level observability
+        # restart/replacement pacing: attempt 1 requeues immediately (a
+        # preempted host must not wait out a penalty it didn't earn),
+        # attempt n >= 2 waits base * 2^(n-2) (capped, jittered) — a
+        # crash-looping worker must not hammer the claim path
+        self.restart_backoff_base_s = restart_backoff_base_s
+        self.restart_backoff_cap_s = restart_backoff_cap_s
+        self.restart_backoff_jitter = restart_backoff_jitter
+        self._backoff_rng = random.Random()
+        self._requeue_at: dict[tuple[str, str], float] = {}
+        # replacement-in-flight fencing: FAILED pods whose delete is
+        # already issued must not re-trigger _handle_failure while the
+        # apiserver/informer catches up (idempotence under event-driven
+        # reconcile); entries auto-expire so a stuck delete re-handles
+        self._replacing: dict[tuple[str, str], dict[str, float]] = {}
+        self._replace_grace_s = 30.0
+        # recovery timeline per job — the bench decomposes
+        # recovery_seconds (detect/claim/...) from these timestamps plus
+        # the worker-side phase stamps
+        self.recovery_log: dict[tuple[str, str], list[dict]] = {}
         # admission hook (PodDefaults registry / webhook equivalent)
         self.pod_mutator = pod_mutator
         # validating-admission hooks run on EVERY submission path (HTTP,
@@ -126,6 +159,9 @@ class JobController:
             self._delete_pods(job)
             self.cluster.delete_service(namespace, job.name)
             self.scheduler.remove_group(namespace, job.name)
+            self._requeue_at.pop((namespace, name), None)
+            self._replacing.pop((namespace, name), None)
+            self.recovery_log.pop((namespace, name), None)
             if self.job_store is not None:
                 self.job_store.delete(job)
 
@@ -148,11 +184,17 @@ class JobController:
             return job
 
         self._ensure_service(job)
-        if job.run_policy.scheduling.gang:
-            self._ensure_podgroup(job)
-            self.scheduler.try_admit()
-        self._ensure_pods(job)
-        self._start_admitted(job)
+        # restart/replacement backoff gate: status keeps converging (a
+        # finished survivor, a deadline) but no pods are (re)created until
+        # the requeue clock expires — the anti-crash-loop pacing
+        requeued = time.time() >= self._requeue_at.get(
+            (namespace, name), 0.0)
+        if requeued:
+            if job.run_policy.scheduling.gang:
+                self._ensure_podgroup(job)
+                self.scheduler.try_admit()
+            self._ensure_pods(job)
+            self._start_admitted(job)
         self._update_status(job)
         self._check_deadline(job)
         self.metrics["reconcile_seconds"] = time.perf_counter() - t0
@@ -203,6 +245,17 @@ class JobController:
                 if self.cluster.get_pod(job.namespace, name) is None:
                     env = self.cluster_env(job, rtype, i)
                     env.update(spec.template.env)
+                    # worker-incarnation stamp: a replacement pod carries
+                    # the dead worker's rank env (computed above — same
+                    # KFT_PROCESS_ID) plus its incarnation id and the
+                    # job's rendezvous epoch, so the worker can tell a
+                    # fresh start from a mid-job takeover and every
+                    # member of the re-formed world agrees on the epoch
+                    if job.status.rendezvous_epoch:
+                        env["KFT_WORKER_INCARNATION"] = str(
+                            job.status.replacement_counts.get(name, 0))
+                        env["KFT_RENDEZVOUS_EPOCH"] = str(
+                            job.status.rendezvous_epoch)
                     tpu = spec.template.tpu
                     pod = Pod(
                         name=name, namespace=job.namespace,
@@ -363,6 +416,33 @@ class JobController:
 
     def _update_status(self, job: JobSpec) -> None:
         pods = self.cluster.list_pods(job.namespace, _job_selector(job))
+        key = (job.namespace, job.name)
+        # purge replacement fences whose pod vanished (delete landed) or
+        # whose delete has been in flight too long (re-handle, never wedge)
+        fences = self._replacing.get(key)
+        if fences:
+            now = time.time()
+            failed_by_name = {p.name: p for p in pods if p is not None
+                              and p.phase == PodPhase.FAILED}
+            for n, (t, expect_inc) in list(fences.items()):
+                p = failed_by_name.get(n)
+                # drop the fence when the fenced pod vanished (delete
+                # landed), when the delete has been in flight too long
+                # (never wedge), or when the FAILED pod under this name
+                # already carries the NEW incarnation id — the replacement
+                # itself died, a second failure mid-recovery that must be
+                # re-handled, not masked. (A lagging informer replay of
+                # the OLD pod carries the old incarnation env and stays
+                # fenced — replacement is never double-fired for one
+                # death.)
+                try:
+                    inc = int((p.env if p is not None else {}).get(
+                        "KFT_WORKER_INCARNATION", -1))
+                except (TypeError, ValueError):
+                    inc = -1
+                if (p is None or inc >= expect_inc
+                        or now - t > self._replace_grace_s):
+                    fences.pop(n, None)
         stats: dict[str, ReplicaStatus] = {}
         for rtype in job.replica_specs:
             stats[rtype] = ReplicaStatus()
@@ -378,7 +458,11 @@ class JobController:
                 rs.succeeded += 1
             elif pod.phase == PodPhase.FAILED:
                 rs.failed += 1
-                any_failed = True
+                # a pod already being replaced (delete issued, apiserver /
+                # informer lag still shows it) must not re-trigger failure
+                # handling — the fence keeps replacement idempotent
+                if pod.name not in self._replacing.get(key, {}):
+                    any_failed = True
         job.status.replica_statuses = stats
 
         success_rtype, success_index = self._success_anchor(job)
@@ -390,7 +474,7 @@ class JobController:
         )
 
         if any_failed:
-            self._handle_failure(job)
+            self._handle_failure(job, pods)
             return
         if anchor is not None and anchor.phase == PodPhase.SUCCEEDED:
             self._set_condition(job, ConditionType.SUCCEEDED, "JobSucceeded")
@@ -409,33 +493,214 @@ class JobController:
                 return rt, 0
         return next(iter(job.replica_specs)), 0
 
-    def _handle_failure(self, job: JobSpec) -> None:
+    def _handle_failure(self, job: JobSpec, pods: list) -> None:
+        key = (job.namespace, job.name)
+        failed = [p for p in pods if p is not None
+                  and p.phase == PodPhase.FAILED
+                  and p.name not in self._replacing.get(key, {})]
+        if not failed:
+            return
         policy = self._restart_policy(job)
         retryable = policy in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS,
                                RestartPolicy.EXIT_CODE)
         if policy == RestartPolicy.EXIT_CODE:
-            pods = self.cluster.list_pods(job.namespace, _job_selector(job))
             # k8s convention: 128+N = killed by signal N. Local Popen reports
             # signal deaths as negative returncodes — both are retryable.
             retryable = any(
-                p is not None and p.phase == PodPhase.FAILED
-                and ((p.exit_code or 0) >= 128 or (p.exit_code or 0) < 0)
-                for p in pods
+                (p.exit_code or 0) >= 128 or (p.exit_code or 0) < 0
+                for p in failed
             )
+        now = time.time()
+        for p in failed:
+            # detection timestamp: the first reconcile that OBSERVES the
+            # failure — the bench's detect phase ends here
+            self._log_recovery(job, "worker_failed", pod=p.name,
+                               exit_code=p.exit_code, t=now)
+        if retryable and self._try_replacement(job, failed, pods):
+            return
         if retryable and job.status.restart_count < job.run_policy.backoff_limit:
             job.status.restart_count += 1
+            delay = self._arm_backoff(job, job.status.restart_count)
             self._set_condition(
                 job, ConditionType.RESTARTING,
                 f"GangRestart#{job.status.restart_count}",
-                "worker failure => whole-slice restart (ICI not elastic)",
+                "worker failure => whole-slice restart "
+                f"(no per-worker replacement possible); backoff {delay:.1f}s",
             )
-            # gang restart: tear down everything, drop the reservation, requeue
+            self._log_recovery(job, "gang_restart",
+                               count=job.status.restart_count,
+                               backoff_s=round(delay, 3))
+            self.metrics["gang_restarts_total"] = (
+                self.metrics.get("gang_restarts_total", 0) + 1)
+            # gang restart: tear down everything, drop the reservation,
+            # requeue; the whole gang re-forms, so per-worker replacement
+            # budgets reset with it (the epoch does NOT — any straggler
+            # from the old world must see a newer epoch, never its own)
+            job.status.rendezvous_epoch += 1
+            job.status.replacement_counts.clear()
+            self._replacing.pop(key, None)
             self._delete_pods(job)
             self.scheduler.remove_group(job.namespace, job.name)
         else:
             self._set_condition(job, ConditionType.FAILED, "BackoffLimitExceeded")
             job.status.completion_time = time.time()
             self._maybe_cleanup(job)
+
+    # ---------------- elastic per-worker replacement ----------------
+
+    def _pod_identity(self, job: JobSpec, pod) -> str:
+        """The job pod identity a cluster pod serves — on the kube backend
+        a claimed warm-pool standby keeps its own name, so identity comes
+        from the replica labels (the per-worker budget must follow the
+        RANK, not whichever standby happened to serve it)."""
+        rtype = pod.labels.get("replica-type")
+        idx = pod.labels.get("replica-index")
+        if rtype is not None and idx is not None:
+            return pod_name(job, rtype, int(idx))
+        return pod.name
+
+    def _try_replacement(self, job: JobSpec, failed: list,
+                         pods: list) -> bool:
+        """Per-worker warm replacement: delete ONLY the dead pods, keep the
+        gang reservation and job uid, recreate the dead ranks under a new
+        worker-incarnation id, and signal survivors to re-rendezvous in
+        place. Returns False (caller falls back to the counted gang
+        restart) when the composition cannot hold: no warm capacity, the
+        coordinator died, a worker exhausted its replacement budget, no
+        standby is claimable, or a survivor cannot be restarted in place."""
+        key = (job.namespace, job.name)
+        pool = getattr(self.cluster, "warm_pool", None)
+        if not pool:
+            return False            # no warm capacity: gang restart
+        # the coordinator (global rank 0) hosts the jax.distributed
+        # rendezvous service of a multi-process world — its death takes
+        # the world's anchor with it; single-process jobs have no
+        # coordinator service, so any rank is replaceable
+        if job.total_replicas > 1:
+            for p in failed:
+                rtype = p.labels.get("replica-type", "")
+                idx = int(p.labels.get("replica-index", 0) or 0)
+                if rtype in job.replica_specs and _global_rank(
+                        job, rtype, idx,
+                        ReplicaType.COORDINATOR.value) == 0:
+                    self._log_recovery(job, "replacement_refused",
+                                       reason="coordinator_died")
+                    return False
+        # per-worker budget (backoff accounting per worker): a rank that
+        # keeps dying burns ITS budget, not the job's gang-restart budget
+        limit = job.run_policy.backoff_limit
+        idents = {p.name: self._pod_identity(job, p) for p in failed}
+        for ident in idents.values():
+            if job.status.replacement_counts.get(ident, 0) >= limit:
+                self._log_recovery(job, "replacement_refused",
+                                   reason="worker_budget_exhausted",
+                                   pod=ident)
+                return False
+        # a real pool (WarmPoolController) must have a claimable standby,
+        # or the replacement would cold-start — worse than the gang
+        # restart it was supposed to beat; truthy warm_pool without
+        # standby accounting (LocalProcessCluster zygote) is always warm
+        if hasattr(pool, "standby_count"):
+            cls = self._pool_class(job)
+            avail = (pool.claimable(cls) if hasattr(pool, "claimable")
+                     else pool.standby_count(cls))
+            if avail < len(failed):
+                self._log_recovery(job, "replacement_refused",
+                                   reason="no_claimable_standby")
+                return False
+        # survivors must be re-rendezvous-able in place (kill + respawn
+        # the process INSIDE the pod: pod identity, claim, node-local
+        # caches all survive); a backend or pod that can't do that forces
+        # the gang path
+        survivors = [p for p in pods if p is not None
+                     and p.phase == PodPhase.RUNNING]
+        restart = getattr(self.cluster, "restart_pod_process", None)
+        if survivors:
+            if restart is None:
+                self._log_recovery(job, "replacement_refused",
+                                   reason="no_in_place_restart")
+                return False
+            can = getattr(self.cluster, "can_restart_in_place",
+                          lambda pod: True)
+            if not all(can(p) for p in survivors):
+                self._log_recovery(job, "replacement_refused",
+                                   reason="survivor_not_restartable")
+                return False
+        # ---- commit ----
+        job.status.rendezvous_epoch += 1
+        epoch = job.status.rendezvous_epoch
+        # survivors re-rendezvous in place under the new epoch FIRST —
+        # their pods (claims, node-local caches) are NOT deleted. A
+        # signal that fails to deliver leaves that survivor wedged in
+        # the old world, so the whole attempt falls back to the counted
+        # gang restart (which tears every member down uniformly); the
+        # epoch bump stands — the gang path bumps past it again.
+        for p in survivors:
+            try:
+                ok = restart(p.namespace, p.name,
+                             {"KFT_RENDEZVOUS_EPOCH": str(epoch)})
+            except Exception:
+                ok = False
+            self._log_recovery(job, "survivor_restarted", pod=p.name,
+                               ok=bool(ok))
+            if not ok:
+                self._log_recovery(job, "replacement_refused",
+                                   reason="survivor_restart_failed",
+                                   pod=p.name)
+                return False
+        attempt = 0
+        for p in failed:
+            ident = idents[p.name]
+            n = job.status.replacement_counts.get(ident, 0) + 1
+            job.status.replacement_counts[ident] = n
+            attempt = max(attempt, n)
+            job.status.worker_replacements += 1
+            self._replacing.setdefault(key, {})[p.name] = (time.time(), n)
+            try:
+                self.cluster.delete_pod(job.namespace, p.name)
+            except Exception:
+                pass        # fence expiry re-handles a stuck delete
+            self._log_recovery(job, "replacement", pod=ident, via=p.name,
+                               incarnation=n, epoch=epoch)
+        self.metrics["worker_replacements_total"] = (
+            self.metrics.get("worker_replacements_total", 0) + len(failed))
+        delay = self._arm_backoff(job, attempt)
+        self._set_condition(
+            job, ConditionType.RESTARTING,
+            f"WorkerReplacement#{job.status.worker_replacements}",
+            f"warm per-worker replacement of {sorted(idents.values())} "
+            f"(epoch {epoch}, gang preserved); backoff {delay:.1f}s",
+        )
+        return True
+
+    def _pool_class(self, job: JobSpec) -> Optional[str]:
+        for spec in job.replica_specs.values():
+            if spec.template.tpu is not None:
+                return spec.template.tpu.accelerator
+        return None
+
+    def _arm_backoff(self, job: JobSpec, attempt: int) -> float:
+        """Exponential backoff with jitter between restart/replacement
+        attempts: attempt 1 requeues immediately, attempt n waits
+        base * 2^(n-2) (capped), +/- jitter. Returns the armed delay."""
+        if attempt <= 1 or self.restart_backoff_base_s <= 0:
+            delay = 0.0
+        else:
+            delay = min(self.restart_backoff_cap_s,
+                        self.restart_backoff_base_s * 2 ** (attempt - 2))
+            if self.restart_backoff_jitter:
+                delay *= 1 + self.restart_backoff_jitter * (
+                    2 * self._backoff_rng.random() - 1)
+        self._requeue_at[(job.namespace, job.name)] = time.time() + delay
+        self.metrics["restart_backoff_seconds"] = delay
+        return delay
+
+    def _log_recovery(self, job: JobSpec, event: str,
+                      t: Optional[float] = None, **fields) -> None:
+        log = self.recovery_log.setdefault((job.namespace, job.name), [])
+        log.append({"t": t if t is not None else time.time(),
+                    "event": event, **fields})
+        del log[:-200]          # bounded per job
 
     def _restart_policy(self, job: JobSpec) -> RestartPolicy:
         w = job.replica_specs.get(ReplicaType.WORKER.value)

@@ -246,6 +246,18 @@ class WarmPoolController:
             1 for c in classes for p in self._pool_pods(c, "standby")
             if p is not None and p.phase not in _TERMINAL)
 
+    def claimable(self, cls: Optional[str] = None) -> int:
+        """RUNNING standbys in a class (or all) — what a claim can
+        actually win right now. The reconciler's per-worker replacement
+        decision keys on this: replacing onto a cold pod would be slower
+        than the gang restart it is meant to beat. Racy by nature (a
+        concurrent claim may win the pod first); the loser of that race
+        cold-falls-back, counted."""
+        classes = [cls] if cls else self.classes
+        return sum(
+            1 for c in classes for p in self._pool_pods(c, "standby")
+            if p is not None and p.phase == PodPhase.RUNNING)
+
     def snapshot(self) -> dict:
         return {
             "claims": self.claims,

@@ -160,25 +160,65 @@ def main() -> int:
         # init compiles and jit setup that precede it — without this
         # stamp a depot hit still looks compile-bound from outside
         _phase(phases, "state_init_done")
+
+        # restart-aware resume handshake (elastic recovery): restore the
+        # latest checkpoint BEFORE loading the compiled executable. A
+        # replacement worker thus knows the exact step it takes over at
+        # up front — and the ordering matters mechanically: a zygote-
+        # forked child that deserializes the depot executable and THEN
+        # runs the tensorstore restore corrupts its forked heap (observed
+        # as SIGABRT/SIGSEGV after the first post-resume step); restore-
+        # then-deserialize is stable. fit() skips its own restore via
+        # already_resumed.
+        from kubeflow_tpu.training.checkpoint import CheckpointManager
+        from kubeflow_tpu.training.loop import restore_latest
+
+        ckpt_dir = os.environ.get("KFT_CHECKPOINT_DIR")
+        resumed = None
+        if ckpt_dir:
+            mgr = CheckpointManager(
+                ckpt_dir,
+                mirror=os.environ.get("KFT_CHECKPOINT_MIRROR") or None)
+            resumed = restore_latest(trainer, mgr)
+            mgr.close()
+            if resumed is not None:
+                phases["resumed_from_step"] = float(resumed)
+                _phase(phases, "restore_done")
+
         depot_outcome = trainer.precompile(
             next(batches(0)), depot=depot, stats=dstats, wait_s=wait_s)
+        # non-timestamp stamp riding the same merge transport: the bench's
+        # recovery decomposition needs the replacement's depot outcome
+        # without scraping logs (1.0 = executable deserialized, no compile)
+        phases["depot_hit"] = 1.0 if depot_outcome == "hit" else 0.0
         _phase(phases, "compile_done",
                extra={"depot": dstats.snapshot()} if depot is not None
                else None)
 
         metrics = MetricsWriter(metrics_path) if metrics_path else None
+        # recovery-bench pacing: a tiny CPU model finishes all its steps
+        # inside one chaos tick — an optional per-step sleep widens the
+        # kill window without changing the math
+        step_sleep = float(os.environ.get("KFT_STEP_SLEEP", "0"))
 
         def _first_step(step, m):
             if "first_step_done" not in phases:
                 _phase(phases, "first_step_done")
+            if step_sleep:
+                import time as _time
+
+                _time.sleep(step_sleep)
 
         result = fit(trainer, batches, rng=jax.random.key(0),
                      max_steps=steps, metrics=metrics, metrics_every=1,
-                     checkpoint_dir=os.environ.get("KFT_CHECKPOINT_DIR"),
-                     on_step=_first_step)
+                     checkpoint_dir=ckpt_dir,
+                     checkpoint_every=int(
+                         os.environ.get("KFT_CHECKPOINT_EVERY", "100")),
+                     on_step=_first_step, already_resumed=resumed)
+        incarnation = os.environ.get("KFT_WORKER_INCARNATION", "0")
         print(f"worker {world.process_id}: trained to step "
               f"{result.final_step} (resumed_from={result.resumed_from}, "
-              f"depot={depot_outcome})")
+              f"depot={depot_outcome}, incarnation={incarnation})")
 
     print(f"worker {world.process_id}/{world.num_processes}: world ok, "
           f"devices={n_global}, collective={total}")

@@ -106,7 +106,7 @@ class CheckpointManager:
         return saved
 
     def restore(self, step: int | None = None, template: Any = None):
-        if self._mgr.latest_step() is None and self.mirror is not None:
+        if self.mirror is not None and self._needs_mirror_fetch(step):
             self._fetch_from_mirror(step)
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
@@ -199,6 +199,28 @@ class CheckpointManager:
                 self._copy(src, dst)
                 copied.append(step)
         return copied
+
+    def _needs_mirror_fetch(self, want: Optional[int]) -> bool:
+        """Restart-aware restore (elastic recovery): a replacement worker
+        may land on a node whose local checkpoint dir is EMPTY (fresh
+        standby) or STALE (the standby served an older incarnation of this
+        job) — in both cases the durable mirror, not the local disk, holds
+        the truth. Fetch when the local dir lacks the requested step, or —
+        for latest-step restores — when the mirror is ahead of it."""
+        local = self._mgr.latest_step()
+        if local is None:
+            return True
+        if want is not None:
+            return want not in self._mgr.all_steps()
+        if _is_remote(self.mirror):
+            return False
+        try:
+            newest = max((int(d) for d in os.listdir(self.mirror)
+                          if d.isdigit() and os.path.isdir(
+                              os.path.join(self.mirror, d))), default=None)
+        except OSError:
+            return False
+        return newest is not None and newest > local
 
     def _fetch_from_mirror(self, want: Optional[int] = None) -> Optional[int]:
         """Local directory empty (node replaced / disk lost): pull the

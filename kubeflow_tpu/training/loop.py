@@ -138,6 +138,45 @@ class Heartbeat:
                 post_heartbeat(self.path, step=step, warning=warning)
 
 
+def restore_latest(trainer: Trainer, mgr: CheckpointManager):
+    """Restore the newest checkpoint into ``trainer`` (params/opt_state
+    re-placed on the template's shardings, step advanced). Returns the
+    restored step or None when no checkpoint exists. Shared by ``fit``
+    and by replacement workers that must restore BEFORE loading the
+    compiled executable (the elastic-recovery takeover order).
+
+    The restored state is laundered through a jitted identity so every
+    buffer is a fresh XLA-runtime allocation. Load-bearing for elastic
+    recovery, not a style choice: restore/device_put hand back arrays
+    whose storage the runtime treats as EXTERNAL, and a DESERIALIZED
+    train step (the executable-depot hit a replacement worker takes)
+    donates its inputs — donating an external buffer to a deserialized
+    executable corrupts the heap (observed: NaN updates from the first
+    donated call, "double free or corruption", SIGSEGV/SIGABRT; a
+    locally jit-compiled step tolerates the same inputs). One extra
+    device-side copy per restore buys a state every executable kind can
+    safely consume."""
+    latest = mgr.latest_step()
+    if latest is None:
+        return None
+    template = {"params": trainer.params,
+                "opt_state": trainer.opt_state}
+    _, state = mgr.restore(latest, template=template)
+    # re-place on the template's shardings: orbax can hand back
+    # scalar/replicated/host leaves, which would otherwise clash with
+    # the mesh-placed params inside the jitted step
+    state = jax.tree_util.tree_map(
+        lambda x, t: jax.device_put(x, t.sharding)
+        if hasattr(t, "sharding") else x,
+        state, template,
+    )
+    state = jax.jit(lambda s: s)(state)     # the buffer launder (above)
+    trainer.params = state["params"]
+    trainer.opt_state = state["opt_state"]
+    trainer.step = latest
+    return latest
+
+
 def fit(
     trainer: Trainer,
     batches: Iterable[Any] | Callable[[int], Iterable[Any]],
@@ -153,6 +192,7 @@ def fit(
     profile_dir: Optional[str] = None,
     profile_steps: tuple[int, int] = (10, 20),
     on_step: Optional[Callable[[int, dict], None]] = None,
+    already_resumed: Optional[int] = None,
 ) -> FitResult:
     """Run training with auto-resume.
 
@@ -162,6 +202,13 @@ def fit(
     a step-indexed dataset can seek directly), or a plain iterable, in which
     case the first ``resumed_from`` batches are consumed and discarded so a
     restarted job sees the same step->batch mapping as an uninterrupted one.
+
+    ``already_resumed`` says the caller restored the checkpoint itself
+    (a replacement worker restores BEFORE loading the depot executable);
+    fit then skips its own restore but still performs the resume
+    handshake: an immediate heartbeat at the takeover step, so the
+    operator's staleness sweep sees the new incarnation live BEFORE the
+    (possibly long) first post-resume step completes.
     """
     # operator contract: pods get KFT_HEARTBEAT_FILE injected; beating it
     # per step is what feeds fault detection and the submit->first-step
@@ -175,30 +222,25 @@ def fit(
     # land it inside the phase the bench attributes to step 1
     if trainer.params is None:
         trainer.init_state(rng)
-    resumed_from = None
+    resumed_from = already_resumed
     mgr = None
     if checkpoint_dir:
         mgr = CheckpointManager(
             checkpoint_dir,
             mirror=checkpoint_mirror
             or os.environ.get("KFT_CHECKPOINT_MIRROR") or None)
-        latest = mgr.latest_step()
-        if latest is not None:
-            template = {"params": trainer.params,
-                        "opt_state": trainer.opt_state}
-            _, state = mgr.restore(latest, template=template)
-            # re-place on the template's shardings: orbax can hand back
-            # scalar/replicated leaves on a single device, which would then
-            # clash with the mesh-placed params inside the jitted step
-            state = jax.tree_util.tree_map(
-                lambda x, t: jax.device_put(x, t.sharding)
-                if hasattr(t, "sharding") else x,
-                state, template,
-            )
-            trainer.params = state["params"]
-            trainer.opt_state = state["opt_state"]
-            trainer.step = latest
-            resumed_from = latest
+        # a caller that already restored (``already_resumed`` — e.g. a
+        # replacement worker that must restore before loading the depot
+        # executable) keeps its state; restoring again here would both
+        # waste the IO and reorder it after the executable load
+        if already_resumed is None:
+            resumed_from = restore_latest(trainer, mgr)
+    if resumed_from is not None and heartbeat is not None:
+        # resume handshake: confirm liveness + the exact takeover step
+        # to the operator NOW — the replacement's first beat must not
+        # wait out the first post-resume step (covers BOTH the
+        # fit-restored and the caller-pre-restored paths)
+        heartbeat.beat(resumed_from)
 
     if callable(batches):
         batches = batches(trainer.step)

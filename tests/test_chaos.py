@@ -101,6 +101,69 @@ def test_real_job_survives_scheduled_chaos(tmp_path):
         cluster.shutdown()
 
 
+def test_injector_kills_kube_pod_via_apiserver():
+    """Satellite: FaultInjector drives the KubeCluster backend instead of
+    raising TypeError — without a node agent, the kill travels through
+    the fake apiserver's status subresource with a retryable signal exit
+    code, and the reconciler recovers from it like any preemption."""
+    from kubeflow_tpu.controller import FakeKubeApiServer, KubeCluster
+
+    srv = FakeKubeApiServer().start()
+    try:
+        kube = KubeCluster(srv.url)
+        ctl = JobController(kube)
+        job = jax_job("kchaos", workers=2, mesh={"data": 2})
+        job.replica_specs["Worker"].restart_policy = RestartPolicy.EXIT_CODE
+        ctl.submit(job)
+        ctl.reconcile("default", "kchaos")
+        kube.run_scheduled()
+
+        chaos = FaultInjector(kube, seed=1)
+        victim = chaos.kill_random("default", {"job-name": "kchaos"})
+        assert victim is not None
+        pod = kube.get_pod("default", victim)
+        assert pod.phase == PodPhase.FAILED and pod.exit_code == -9
+        ctl.reconcile("default", "kchaos")
+        out = ctl.get("default", "kchaos")
+        assert out.status.restart_count >= 1       # retryable, recovered
+    finally:
+        srv.stop()
+
+
+def test_injector_max_kills_race_safe_under_concurrency():
+    """Satellite: the max_kills budget must hold even when the scheduled
+    loop and concurrent direct kill_pod calls race over it."""
+    import threading
+
+    cluster = FakeCluster()
+    ctl = JobController(cluster)
+    job = jax_job("race", workers=16, mesh={"data": 16})
+    ctl.submit(job)
+    ctl.reconcile("default", "race")
+    for pod in cluster.list_pods("default", {"job-name": "race"}):
+        cluster.set_phase("default", pod.name, PodPhase.RUNNING)
+
+    chaos = FaultInjector(cluster, seed=4)
+    chaos.start("default", {"job-name": "race"},
+                period_s=0.01, max_kills=3)
+    barrier = threading.Barrier(8)
+
+    def hammer(i):
+        barrier.wait()
+        for j in range(16):
+            chaos.kill_pod("default", f"race-worker-{(i * 16 + j) % 16}")
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert chaos.wait_for_kill(3, timeout_s=10)
+    time.sleep(0.1)
+    chaos.stop()
+    assert len(chaos.kills) == 3                   # never overshoots
+
+
 def test_dead_checkpoint_mirror_surfaces_warning_condition(
         tmp_path, monkeypatch):
     """Kill the checkpoint-mirror path (copy_fn always raises): the worker's
