@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -183,9 +183,41 @@ test-elastic:
 		assert e['replacement_cold_fallbacks'] == 0, ('cold fallback', d); \
 		assert all(k in p for k in ('detect', 'claim', 'load', 'rendezvous', 'first_step_after')), d; \
 		assert c['exact'] is True and c['steps_compared'] >= 1, ('loss diverged', d); \
+		t = e['trace']; \
+		assert t['coherent'] is True and t['agrees_within_10pct'] is True, \
+			('operator job trace disagrees with measured phases', t); \
 		print('elastic recovery bench OK: recovery_seconds=' + str(d['value']) \
 			+ ' phases=' + json.dumps(p) \
 			+ ' resumed_from=' + str(e['resumed_from_step']))"
+
+# end-to-end observability (ISSUE 14): the obs unit suite (span
+# collector ring/races, histogram percentiles, exposition lint against
+# BOTH /metrics surfaces, trace propagation under failure, profiler env
+# wiring), then the obs bench smoke. Two independent teeth (like
+# test-serving-sched): bench.py exits nonzero unless ONE real served
+# request produced a >=6-span trace (router/server/queue/prefill-chunk/
+# decode-step sharing a propagated trace id), the Perfetto export
+# loads, /metrics lints clean and all three request histograms have
+# nonzero counts; the JSON contract is then re-checked from the
+# captured file so a silently-vanished span family or histogram
+# regresses visibly.
+OBS_SMOKE_JSON := /tmp/kft-obs-smoke.json
+test-obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --obs-smoke > $(OBS_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(OBS_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; names = set(e['span_names']); \
+		assert e['trace_spans'] >= 6, ('trace too shallow', d); \
+		assert {'router.route', 'server.infer', 'request.queue', \
+			'prefill.chunk', 'decode.step'} <= names, names; \
+		assert e['trace_coherent'] is True, ('orphan spans', d); \
+		assert all(e['histogram_counts'][k] > 0 for k in ('ttft', 'itl', 'e2e')), d; \
+		assert e['metrics_valid'] is True, ('exposition lint failed', e.get('metrics_lint')); \
+		assert e['perfetto_events'] >= 6, d; \
+		print('obs bench OK: spans=' + str(e['trace_spans']) \
+			+ ' hist_counts=' + json.dumps(e['histogram_counts']) \
+			+ ' export=' + str(e['perfetto_export']))"
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -27,18 +27,25 @@ from typing import Optional
 from kubeflow_tpu.api.types import Condition, ConditionType, from_yaml, to_yaml
 from kubeflow_tpu.controller.heartbeat import FileHeartbeatTracker, check_heartbeats
 from kubeflow_tpu.controller.reconciler import JobController
+from kubeflow_tpu.obs import expo as obs_expo
+from kubeflow_tpu.obs import export as obs_export
+from kubeflow_tpu.obs.histogram import Histogram
 from kubeflow_tpu.parallel.depot import (
     DEPOT_REPLACE_HEADER, DEPOT_TOKEN_HEADER,
 )
 
 
 class Metrics:
-    """Minimal Prometheus-style registry (counters + gauges, text format)."""
+    """Minimal Prometheus-style registry (counters + gauges + histograms),
+    rendered through the ONE shared exposition helper (obs/expo.py) the
+    model server also uses — # HELP/# TYPE per family, counter names
+    enforced to the _total/_sum/_count convention at render time."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        self._hists: dict[str, Histogram] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[dict] = None) -> str:
@@ -56,32 +63,42 @@ class Metrics:
         with self._lock:
             self._gauges[self._key(name, labels)] = value
 
+    def observe(self, name: str, value: float,
+                labels: Optional[dict] = None):
+        """Record into a histogram family (created on first use). Family
+        names must end in _seconds (the timing convention the exposition
+        helper enforces)."""
+        key = self._key(name, labels)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = Histogram()
+        hist.observe(value)
+
     def get(self, name: str, labels: Optional[dict] = None) -> Optional[float]:
         key = self._key(name, labels)
         with self._lock:
             return self._counters.get(key, self._gauges.get(key))
 
     @staticmethod
-    def _bare(key: str) -> str:
-        return key.split("{", 1)[0]
+    def _split(key: str) -> tuple[str, Optional[str]]:
+        bare, _, rest = key.partition("{")
+        return bare, (rest[:-1] if rest else None)
 
     def render(self) -> str:
-        """Prometheus exposition text, with # HELP/# TYPE headers so a real
-        scraper ingests it cleanly (one header per metric family)."""
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
-        lines: list[str] = []
-        for items, mtype in ((counters, "counter"), (gauges, "gauge")):
-            prev = None
+            hists = sorted(self._hists.items())
+        families: dict[tuple, list] = {}
+        for items, mtype in ((counters, "counter"), (gauges, "gauge"),
+                             (hists, "histogram")):
             for k, v in items:
-                bare = self._bare(k)
-                if bare != prev:
-                    lines.append(f"# HELP {bare} kubeflow_tpu {mtype}")
-                    lines.append(f"# TYPE {bare} {mtype}")
-                    prev = bare
-                lines.append(f"{k} {v}")
-        return "\n".join(lines) + "\n"
+                bare, labels = self._split(k)
+                families.setdefault((bare, mtype), []).append((labels, v))
+        return obs_expo.render_exposition(
+            [(name, mtype, samples)
+             for (name, mtype), samples in families.items()])
 
 
 class Operator:
@@ -217,6 +234,10 @@ class Operator:
         # shared fs. uid-scoped like the warning files: a resubmitted
         # same-name job must not inherit a dead incarnation's stamps.
         self.phase_reports: dict[tuple[str, str, str, str], dict] = {}
+        # worker-POSTed explicit spans (same heartbeat transport, key
+        # "spans"): merged with the phase-derived spans + the reconciler
+        # recovery log into the /apis/v1/trace/{ns}/{job} job trace
+        self.span_reports: dict[tuple[str, str, str, str], list] = {}
         # heartbeat transport for pods that share no filesystem with this
         # daemon (KubeCluster): inject an http URL instead of a file path;
         # the POST handler writes the SAME tracker files locally, keeping
@@ -250,6 +271,17 @@ class Operator:
                         # shared fs: workers read/publish the depot
                         # directory itself — no HTTP round trip
                         pod.env.setdefault("KFT_DEPOT", self.depot.path)
+                    if self.advertise_url:
+                        # phase stamps still POST over HTTP even on a
+                        # shared fs: phase_reports (and the job trace
+                        # built from them at /apis/v1/trace) must not be
+                        # kube-backend-only
+                        pod.env.setdefault(
+                            "KFT_PHASES_PATH",
+                            f"{self.advertise_url.rstrip('/')}/apis/v1/"
+                            f"namespaces/{pod.namespace}/jobs/{job}/pods/"
+                            f"{pod.name}/heartbeat"
+                            f"?uid={pod.labels.get('job-uid', '')}")
                 elif self.advertise_url:
                     # uid-scoped like the file transport: a zombie pod of
                     # a dead incarnation must not feed the new job
@@ -328,6 +360,9 @@ class Operator:
             for key in [k for k in self.phase_reports
                         if k[0] == ns and k[1] == name]:
                 self.phase_reports.pop(key, None)
+            for key in [k for k in self.span_reports
+                        if k[0] == ns and k[1] == name]:
+                self.span_reports.pop(key, None)
             for key in [k for k in self._depot_reported
                         if k[0] == ns and k[1] == name]:
                 self._depot_reported.pop(key, None)
@@ -456,11 +491,42 @@ class Operator:
             # submit→first-step decomposition over the wire (kube backend:
             # no shared fs). Merge — workers re-post the whole dict per
             # phase, and a lagging duplicate must not erase a later stamp.
-            clean = {str(k): float(v) for k, v in phases.items()
-                     if isinstance(v, (int, float))}
+            # Short strings ride too (artifact stamps like the profiler's
+            # trace-dir path): they surface as job-trace span attrs, never
+            # as timestamps.
+            clean: dict = {}
+            for k, v in phases.items():
+                if isinstance(v, (int, float)):
+                    clean[str(k)] = float(v)
+                elif isinstance(v, str) and len(v) <= 512:
+                    clean[str(k)] = v
             with self._lock:
                 self.phase_reports.setdefault(
                     (ns, job_name, job.uid, pod_name), {}).update(clean)
+        spans = body.get("spans")
+        if isinstance(spans, list):
+            # explicit worker spans over the same transport: validated
+            # field-by-field (untrusted body) and bounded per pod
+            clean_spans = []
+            for s in spans[:64]:
+                if not isinstance(s, dict):
+                    continue
+                try:
+                    rec = {"name": str(s["name"])[:128],
+                           "t0": float(s["t0"]), "t1": float(s["t1"])}
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if isinstance(s.get("attrs"), dict):
+                    rec["attrs"] = {str(k)[:64]: v
+                                    for k, v in s["attrs"].items()
+                                    if isinstance(v, (int, float, str))}
+                clean_spans.append(rec)
+            if clean_spans:
+                with self._lock:
+                    store = self.span_reports.setdefault(
+                        (ns, job_name, job.uid, pod_name), [])
+                    store.extend(clean_spans)
+                    del store[:-256]          # bounded per pod
         depot = body.get("depot")
         if isinstance(depot, dict):
             # worker-side depot counters (hits / deserialize_failures /
@@ -545,6 +611,32 @@ class Operator:
         with self._lock:
             return [dict(e) for e in
                     self.controller.recovery_log.get((ns, job_name), [])]
+
+    def job_trace(self, ns: str, job_name: str) -> list[dict]:
+        """The operator-merged job trace: worker phase reports (carried
+        over the heartbeat transport) + the reconciler recovery log +
+        any explicitly POSTed worker spans, folded into one span list by
+        obs/export.build_job_trace. Served at /apis/v1/trace/{ns}/{job}
+        (depot-token-fenced); ?format=chrome exports Perfetto JSON.
+        Current incarnation only, like job_phases."""
+        job = self.controller.get(ns, job_name)
+        if job is None:
+            return []
+        uid = job.uid
+        with self._lock:
+            phases = {pod: dict(ph)
+                      for (pns, pjob, puid, pod), ph
+                      in self.phase_reports.items()
+                      if pns == ns and pjob == job_name and puid == uid}
+            posted = {pod: [dict(s) for s in spans]
+                      for (pns, pjob, puid, pod), spans
+                      in self.span_reports.items()
+                      if pns == ns and pjob == job_name and puid == uid}
+            events = [dict(e) for e in
+                      self.controller.recovery_log.get((ns, job_name), [])]
+        return obs_export.build_job_trace(
+            ns, job_name, uid, phases,
+            recovery_events=events, worker_spans=posted)
 
     def _tick_warm_pool(self) -> None:
         """Replenish/reap the warm pool and export its counters — runs on
@@ -881,6 +973,46 @@ def _make_http_server(op: Operator, port: int,
                 return parts[3] if len(parts) == 4 else ""
             return None
 
+        def _trace_path(self):
+            # /apis/v1/trace/{ns}/{job}[?format=chrome]
+            from urllib.parse import parse_qs
+
+            route, _, query = self.path.partition("?")
+            parts = route.strip("/").split("/")
+            if parts[:3] == ["apis", "v1", "trace"] and len(parts) == 5:
+                fmt = (parse_qs(query).get("format") or ["spans"])[0]
+                return parts[3], parts[4], fmt
+            return None
+
+        def _trace(self, ns: str, job: str, fmt: str):
+            """Job-trace route — auth-fenced like the depot endpoint:
+            the operator-injected depot token admits workers/tools (they
+            hold no bearer tokens), and a bearer token with read rights
+            in the namespace admits humans when auth is configured.
+            Execution timelines leak workload structure, so with a depot
+            configured and no valid credential the route refuses; only a
+            depot-less, auth-less local-dev daemon serves it openly
+            (matching every other control-plane GET in that mode)."""
+            if not op.depot_authorized(
+                    self.headers.get(DEPOT_TOKEN_HEADER)):
+                if op.auth is not None:
+                    res = op.auth.check(
+                        self.headers.get("Authorization"), "GET", ns)
+                    if not res.allowed:
+                        return self._send(
+                            res.status, json.dumps({"error": res.reason}))
+                elif op.depot is not None:
+                    return self._send(
+                        403, '{"error": "depot token required"}')
+            if op.controller.get(ns, job) is None:
+                return self._send(404, '{"error": "unknown job"}')
+            spans = op.job_trace(ns, job)
+            if fmt == "chrome":
+                from kubeflow_tpu.obs.export import chrome_trace
+
+                return self._send(200, json.dumps(chrome_trace(spans)))
+            return self._send(200, json.dumps({"spans": spans}))
+
         def _send_bytes(self, code: int, data: bytes,
                         ctype: str = "application/octet-stream"):
             self.send_response(code)
@@ -1008,6 +1140,9 @@ def _make_http_server(op: Operator, port: int,
                 # worker-facing like the heartbeat sink (workers hold no
                 # bearer tokens) — fenced by the depot token instead
                 return self._depot("GET", dp)
+            tp = self._trace_path()
+            if tp is not None:
+                return self._trace(*tp)
             if not self._authorized():
                 return
             if self._maybe_proxy("GET"):

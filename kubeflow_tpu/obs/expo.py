@@ -1,0 +1,225 @@
+"""The ONE Prometheus text-exposition helper — and its lint validator.
+
+Every ``/metrics`` surface in this repo (operator daemon, model server)
+renders through ``render_exposition`` so the format rules live in one
+place instead of three hand-rolled f-string blocks:
+
+- exactly one ``# HELP`` + ``# TYPE`` header per family, emitted before
+  the family's first sample;
+- counter families MUST end in ``_total`` (or be the ``_sum``/``_count``
+  components of a timing pair) — enforced, a violation raises at render
+  time instead of shipping a malformed family;
+- histogram families MUST end in ``_seconds`` (every timing family in
+  this repo measures seconds) and render the full cumulative
+  ``_bucket``/``_sum``/``_count`` triplet via ``Histogram.render_lines``.
+
+``validate_exposition`` is the matching lint used by the test suite and
+the obs smoke: it re-parses scraped text and returns every violation,
+so a counter rename or a hand-rolled exposition sneaking back in
+regresses visibly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Optional, Union
+
+from kubeflow_tpu.obs.histogram import Histogram
+
+# counter component suffixes: _total for plain counters; _sum/_count are
+# the monotonic halves of a timing pair or histogram
+COUNTER_SUFFIXES = ("_total", "_sum", "_count")
+
+# family name -> human help line (optional; a generic line otherwise)
+HELP: dict[str, str] = {
+    "kft_model_request_ttft_seconds":
+        "Time to first token per request (enqueue -> first commit)",
+    "kft_model_request_itl_seconds":
+        "Inter-token latency per generated token (chunk-amortized)",
+    "kft_model_request_e2e_seconds":
+        "End-to-end request latency (enqueue -> finish)",
+}
+
+Sample = tuple[Optional[str], Union[float, Histogram, dict]]
+Family = tuple[str, str, list[Sample]]
+
+
+def _check_name(name: str, mtype: str) -> None:
+    if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name):
+        raise ValueError(f"invalid metric family name {name!r}")
+    if mtype == "counter" and not name.endswith(COUNTER_SUFFIXES):
+        raise ValueError(
+            f"counter family {name!r} must end in _total (or _sum/_count)")
+    if mtype == "histogram" and not name.endswith("_seconds"):
+        raise ValueError(
+            f"histogram family {name!r} must end in _seconds "
+            "(timing families are measured in seconds)")
+
+
+def render_exposition(families: Iterable[Family]) -> str:
+    """Families -> Prometheus text. Each family is
+    ``(name, type, samples)`` with type in counter|gauge|histogram and
+    samples ``[(inner_label_str_or_None, value)]``; histogram sample
+    values are ``Histogram`` objects or their ``snapshot()`` dicts."""
+    lines: list[str] = []
+    seen: set[str] = set()
+    for name, mtype, samples in families:
+        if mtype not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric type {mtype!r} for {name!r}")
+        _check_name(name, mtype)
+        if name in seen:
+            raise ValueError(f"family {name!r} rendered twice")
+        seen.add(name)
+        lines.append(f"# HELP {name} "
+                     f"{HELP.get(name, f'kubeflow_tpu {mtype}')}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if mtype == "histogram":
+                hist = (value if isinstance(value, Histogram)
+                        else Histogram.from_snapshot(value))
+                lines.extend(hist.render_lines(name, labels))
+            else:
+                tail = f"{{{labels}}}" if labels else ""
+                lines.append(f"{name}{tail} {float(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def family_of(sample_name: str) -> str:
+    """Sample name -> family name (histogram components fold in)."""
+    bare = sample_name.split("{", 1)[0]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if bare.endswith(suffix):
+            return bare[: -len(suffix)]
+    return bare
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Lint a scraped /metrics body; returns problems ([] = valid).
+
+    Checks: parsable sample lines; one HELP+TYPE per family before its
+    first sample; counters end in _total/_sum/_count; histogram families
+    end in _seconds with cumulative le-ordered buckets, a +Inf bucket
+    equal to _count, and both _sum and _count present."""
+    problems: list[str] = []
+    types: dict[str, str] = {}
+    helped: set[str] = set()
+    # histogram family -> labelset -> [(le, cum)], count, sum-present
+    hist: dict[str, dict[str, dict]] = {}
+    samples_seen: set[str] = set()
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                problems.append(f"line {lineno}: HELP without text")
+            else:
+                helped.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                problems.append(f"line {lineno}: malformed TYPE: {line!r}")
+                continue
+            fam, mtype = parts[2], parts[3]
+            if fam in types:
+                problems.append(f"line {lineno}: duplicate TYPE for {fam}")
+            if fam in samples_seen:
+                problems.append(
+                    f"line {lineno}: TYPE for {fam} after its samples")
+            types[fam] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {lineno}: unparsable sample: {line!r}")
+            continue
+        name, labelblock, value = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            fval = float(value)
+        except ValueError:
+            problems.append(f"line {lineno}: non-numeric value: {line!r}")
+            continue
+        fam = family_of(name)
+        samples_seen.add(fam)
+        mtype = types.get(fam)
+        if mtype is None:
+            # a bare name that IS its own family (e.g. a gauge named
+            # *_count would fold wrongly) — accept exact-name TYPE too
+            mtype = types.get(name)
+            if mtype is not None:
+                fam = name
+                samples_seen.add(fam)
+        if mtype is None:
+            problems.append(
+                f"line {lineno}: sample {name!r} has no TYPE header")
+            continue
+        if fam not in helped:
+            problems.append(f"line {lineno}: family {fam} missing HELP")
+        if mtype == "counter":
+            if not fam.endswith(COUNTER_SUFFIXES):
+                problems.append(
+                    f"counter family {fam} must end in _total/_sum/_count")
+            if fval < 0:
+                problems.append(f"line {lineno}: negative counter {fam}")
+        if mtype == "histogram":
+            if not fam.endswith("_seconds"):
+                problems.append(
+                    f"histogram family {fam} must end in _seconds")
+            # group the series by its labels MINUS le: split the block
+            # into name="value" pairs and drop le, so the grouping is
+            # independent of label ORDER (a producer emitting le first
+            # must not lint as a broken histogram) and an le-only block
+            # matches the bare _sum/_count lines
+            pairs = re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]'
+                               r'|\\.)*)"', labelblock)
+            kept = [f'{k}="{v}"' for k, v in pairs if k != "le"]
+            labels = "{" + ",".join(sorted(kept)) + "}" if kept else ""
+            entry = hist.setdefault(fam, {}).setdefault(
+                labels, {"buckets": [], "count": None, "sum": False})
+            if name.endswith("_bucket"):
+                le = re.search(r'le="([^"]*)"', labelblock)
+                if le is None:
+                    problems.append(
+                        f"line {lineno}: histogram bucket without le")
+                else:
+                    entry["buckets"].append((le.group(1), fval))
+            elif name.endswith("_count"):
+                entry["count"] = fval
+            elif name.endswith("_sum"):
+                entry["sum"] = True
+            else:
+                problems.append(
+                    f"line {lineno}: stray histogram sample {name!r}")
+
+    for fam, series in hist.items():
+        for labels, entry in series.items():
+            where = f"{fam}{labels or ''}"
+            buckets = entry["buckets"]
+            if not buckets:
+                problems.append(f"{where}: histogram with no buckets")
+                continue
+            if buckets[-1][0] != "+Inf":
+                problems.append(f"{where}: last bucket is not le=+Inf")
+            finite = [float(le) for le, _ in buckets[:-1]]
+            if finite != sorted(finite):
+                problems.append(f"{where}: bucket bounds not ascending")
+            counts = [c for _, c in buckets]
+            if counts != sorted(counts):
+                problems.append(f"{where}: bucket counts not cumulative")
+            if entry["count"] is None:
+                problems.append(f"{where}: missing _count")
+            elif buckets[-1][1] != entry["count"]:
+                problems.append(
+                    f"{where}: +Inf bucket != _count "
+                    f"({buckets[-1][1]} vs {entry['count']})")
+            if not entry["sum"]:
+                problems.append(f"{where}: missing _sum")
+    return problems

@@ -1,0 +1,295 @@
+"""Span export (Chrome trace events / Perfetto) + operator job traces.
+
+Two producers feed one consumer format:
+
+- data plane: the per-process ``SpanCollector`` rings (router, model
+  server, engine) — ``merge_spans`` + ``chrome_trace`` turn them into a
+  single JSON document ``chrome://tracing`` and https://ui.perfetto.dev
+  load directly (trace-event format, "X" complete events, microsecond
+  timestamps, one pid per producer process).
+- control plane: workers report phase timestamps (and optional explicit
+  spans) over the heartbeat POST; the reconciler logs recovery events.
+  ``build_job_trace`` merges both into span dicts per job — the
+  operator serves it at ``/apis/v1/trace/{ns}/{job}`` and the recovery
+  bench asserts its durations against the measured ``recovery_seconds``
+  phases.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Iterable, Optional
+
+from kubeflow_tpu.obs.trace import new_span_id, span_in_trace
+
+# ------------------------------------------------------ chrome export --
+
+
+def merge_spans(*span_lists: Iterable[dict]) -> list[dict]:
+    """Concatenate span dicts from many collectors/processes, ordered by
+    start time (the exporter's input contract)."""
+    out: list[dict] = []
+    for spans in span_lists:
+        out.extend(spans)
+    out.sort(key=lambda s: s.get("t0", 0.0))
+    return out
+
+
+def spans_for(spans: Iterable[dict], trace_id: str) -> list[dict]:
+    """Filter merged spans to one trace (the shared ``span_in_trace``
+    membership rule — engine dispatches covering several requests carry
+    their traces in ``attrs.trace_ids``)."""
+    return [s for s in spans if span_in_trace(s, trace_id)]
+
+
+def chrome_trace(spans: Iterable[dict]) -> dict:
+    """Span dicts -> a Chrome-trace-event document (Perfetto-loadable).
+
+    Every closed span becomes one complete ("X") event; open spans are
+    skipped (the collector's abort contract is supposed to have closed
+    them). Each distinct ``proc`` string becomes a pid with a
+    process_name metadata event so Perfetto labels the tracks."""
+    spans = list(spans)
+    procs: dict[str, int] = {}
+    events: list[dict] = []
+    for s in spans:
+        if s.get("t1") is None:
+            continue
+        proc = s.get("proc") or "process"
+        pid = procs.setdefault(proc, len(procs) + 1)
+        args = {k: v for k, v in (s.get("attrs") or {}).items()}
+        args["trace_id"] = s.get("trace_id")
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_id"):
+            args["parent_id"] = s["parent_id"]
+        events.append({
+            "name": s.get("name", "span"),
+            "ph": "X",
+            "ts": s["t0"] * 1e6,                  # microseconds
+            "dur": max(0.0, (s["t1"] - s["t0"]) * 1e6),
+            "pid": pid,
+            "tid": int(s.get("tid") or 0) % 100000,
+            "cat": s.get("name", "span").split(".")[0],
+            "args": args,
+        })
+    for proc, pid in procs.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": proc}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, spans: Iterable[dict]) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    return path
+
+
+def validate_trace(spans: Iterable[dict]) -> list[str]:
+    """Coherence lint for a span list: every span closed, every
+    parent_id resolvable WITHIN the list or explicitly external (the
+    trace root's parent from another process). Returns problems."""
+    spans = list(spans)
+    ids = {s.get("span_id") for s in spans}
+    external = {s.get("attrs", {}).get("external_parent")
+                for s in spans}
+    problems = []
+    for s in spans:
+        if s.get("t1") is None:
+            problems.append(f"span {s.get('name')} never closed")
+        p = s.get("parent_id")
+        if p is not None and p not in ids and p not in external:
+            problems.append(
+                f"span {s.get('name')} has orphan parent {p}")
+    return problems
+
+
+# ---------------------------------------------------- operator traces --
+
+# consecutive worker phase stamps -> span names; start resolves the
+# FIRST present key (runs without checkpointing have no restore_done,
+# smoke runs have no state_init_done)
+_WORKER_SEGMENTS = (
+    ("worker.imports", ("proc_start",), "imports_done"),
+    ("worker.rendezvous", ("imports_done",), "rendezvous_done"),
+    ("worker.state_init", ("rendezvous_done",), "state_init_done"),
+    ("worker.restore", ("state_init_done", "rendezvous_done"),
+     "restore_done"),
+    ("worker.compile",
+     ("restore_done", "state_init_done", "rendezvous_done"),
+     "compile_done"),
+    ("worker.first_step", ("compile_done", "rendezvous_done"),
+     "first_step_done"),
+    # profile_start is stamped by the worker at the REAL
+    # jax.profiler.start_trace time; first_step_done is only the
+    # legacy fallback for stamps predating it
+    ("worker.profile", ("profile_start", "first_step_done"),
+     "profile_done"),
+)
+
+
+def job_trace_id(namespace: str, name: str, uid: str) -> str:
+    """Deterministic trace id for a job incarnation: every merger (two
+    operators, a restarted one) labels the same job with the same id."""
+    return hashlib.sha256(
+        f"{namespace}/{name}/{uid}".encode()).hexdigest()[:32]
+
+
+def _span(name, trace_id, t0, t1, parent=None, attrs=None, proc=""):
+    return {"name": name, "trace_id": trace_id, "span_id": new_span_id(),
+            "parent_id": parent, "t0": float(t0), "t1": float(t1),
+            "attrs": dict(attrs or {}), "proc": proc, "tid": 0}
+
+
+def _segments(ph: dict, trace_id: str, parent: str, pod: str) -> list:
+    out = []
+    for name, starts, end in _WORKER_SEGMENTS:
+        if end not in ph:
+            continue
+        t0 = next((ph[k] for k in starts if k in ph), None)
+        if t0 is None or ph[end] < t0:
+            continue
+        out.append(_span(name, trace_id, t0, ph[end], parent=parent,
+                         attrs={"pod": pod}, proc=f"worker:{pod}"))
+    return out
+
+
+def build_job_trace(namespace: str, name: str, uid: str,
+                    phase_reports: dict[str, dict],
+                    recovery_events: Optional[list[dict]] = None,
+                    worker_spans: Optional[dict[str, list]] = None
+                    ) -> list[dict]:
+    """Operator-side merge: per-pod phase stamps (heartbeat transport) +
+    reconciler recovery events (+ any spans workers POSTed explicitly)
+    -> one job trace.
+
+    Per pod: a ``worker:{pod}`` root span covering its stamps, child
+    segment spans per consecutive stamp pair; non-timestamp stamps
+    (depot_hit, resumed_from_step, profile_dir) ride the root's attrs.
+    Per ``replacement`` recovery event, the bench's recovery phases are
+    reproduced as spans — claim (detection -> replacement process
+    alive), load.imports, rendezvous, load.acquire (restore + depot
+    deserialize / compile), first_step_after — durations the bench
+    asserts against its own ``recovery_seconds`` decomposition. The
+    ``detect`` phase needs the kill wall-time only the chaos injector
+    knows, so it stays bench-side. Refusal/failure events become
+    zero-length instant spans: a replacement that died mid-claim leaves
+    a coherent trace, not a hole."""
+    trace_id = job_trace_id(namespace, name, uid)
+    events = list(recovery_events or [])
+    spans: list[dict] = []
+    all_ts = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
+
+    # job root span (the trace anchor every parent chain resolves to)
+    pod_roots: dict[str, str] = {}
+    for pod, ph in sorted(phase_reports.items()):
+        ts = [v for v in ph.values() if isinstance(v, (int, float))
+              and v > 1e9]           # timestamps, not counters/stamps
+        if not ts:
+            continue
+        all_ts.extend(ts)
+    for posted in (worker_spans or {}).values():
+        # explicit worker spans anchor the trace too: a job whose ONLY
+        # observations are POSTed spans must not export empty
+        all_ts.extend(s["t0"] for s in posted)
+        all_ts.extend(s["t1"] for s in posted)
+    if not all_ts:
+        return []
+    root = _span(f"job:{name}", trace_id, min(all_ts), max(all_ts),
+                 attrs={"namespace": namespace, "job": name, "uid": uid},
+                 proc="operator")
+    spans.append(root)
+
+    for pod, ph in sorted(phase_reports.items()):
+        ts = {k: v for k, v in ph.items()
+              if isinstance(v, (int, float)) and v > 1e9}
+        if not ts:
+            continue
+        extras = {k: v for k, v in ph.items() if k not in ts}
+        pod_root = _span(f"worker:{pod}", trace_id, min(ts.values()),
+                         max(ts.values()), parent=root["span_id"],
+                         attrs={"pod": pod, **extras},
+                         proc=f"worker:{pod}")
+        pod_roots[pod] = pod_root["span_id"]
+        spans.append(pod_root)
+        spans.extend(_segments(ts, trace_id, pod_root["span_id"], pod))
+
+    # recovery events: instant spans for every logged event, plus the
+    # phase spans for each replacement that has a matching set of
+    # replacement-worker stamps (restore_done marks the takeover pod)
+    for e in events:
+        t = e.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        attrs = {k: v for k, v in e.items() if k != "t"}
+        spans.append(_span(f"recovery.{e.get('event', 'event')}",
+                           trace_id, t, t, parent=root["span_id"],
+                           attrs=attrs, proc="operator"))
+    replacements = [e for e in events if e.get("event") == "replacement"]
+    fails = [e["t"] for e in events if e.get("event") == "worker_failed"]
+    # an incarnation's claim window ends at the NEXT failure/replacement
+    # event: a later incarnation's stamps must never also satisfy an
+    # earlier event (a replacement that died mid-claim would otherwise
+    # duplicate the surviving incarnation's whole recovery span set and
+    # stretch its claim span across the second failure)
+    cuts = sorted({e["t"] for e in events
+                   if e.get("event") in ("worker_failed", "replacement")
+                   and isinstance(e.get("t"), (int, float))})
+    _need = ("proc_start", "imports_done", "rendezvous_done",
+             "compile_done", "first_step_done")
+    for e in replacements:
+        # the stamps of the pod that SERVED the replacement: on the kube
+        # backend a claimed warm standby reports under its OWN pod name,
+        # not the job identity in the event — so match by takeover time
+        # (first full report whose proc_start falls in THIS event's
+        # window), preferring an exact name match when one exists
+        window_end = next((t for t in cuts if t > e["t"]), float("inf"))
+
+        def _full(p):
+            ph2 = phase_reports.get(p) or {}
+            return (ph2 if all(k in ph2 for k in _need)
+                    and e["t"] - 1e-3 <= ph2["proc_start"] < window_end
+                    else None)
+
+        ph = _full(e.get("pod"))
+        pod = e.get("pod")
+        if ph is None:
+            candidates = [(p2, ph2) for p2 in sorted(phase_reports)
+                          if (ph2 := _full(p2)) is not None]
+            if candidates:
+                pod, ph = min(candidates,
+                              key=lambda c: c[1]["proc_start"])
+        if ph is None:
+            # replacement died before reporting (mid-claim), or its
+            # stamps belong to a later incarnation: the instant event
+            # above is the whole record — still a coherent trace
+            continue
+        t_detect = max((t for t in fails if t <= e["t"]),
+                       default=e["t"])
+        parent = pod_roots.get(pod, root["span_id"])
+        rec = [
+            ("recovery.claim", t_detect, ph["proc_start"]),
+            ("recovery.load.imports", ph["proc_start"],
+             ph["imports_done"]),
+            ("recovery.rendezvous", ph["imports_done"],
+             ph["rendezvous_done"]),
+            ("recovery.load.acquire", ph["rendezvous_done"],
+             ph["compile_done"]),
+            ("recovery.first_step_after", ph["compile_done"],
+             ph["first_step_done"]),
+        ]
+        for rname, t0, t1 in rec:
+            if t1 < t0:
+                continue
+            spans.append(_span(rname, trace_id, t0, t1, parent=parent,
+                               attrs={"pod": pod,
+                                      "incarnation": e.get("incarnation")},
+                               proc="operator"))
+    for pod, posted in sorted((worker_spans or {}).items()):
+        for s in posted:
+            spans.append(_span(
+                s.get("name", "worker.span"), trace_id, s["t0"], s["t1"],
+                parent=pod_roots.get(pod, root["span_id"]),
+                attrs=dict(s.get("attrs") or {}), proc=f"worker:{pod}"))
+    spans.sort(key=lambda s: s["t0"])
+    return spans

@@ -13,10 +13,13 @@ import os
 import sys
 
 
-def _phase(phases: dict, name: str, extra: dict | None = None) -> None:
-    """Record a named absolute timestamp; flushed to KFT_PHASES_PATH so the
-    operator/bench can decompose submit->first-step into pod spawn /
-    imports / rendezvous / compile / step 1 (BASELINE.md row 2).
+def _phase(phases: dict, name: str, extra: dict | None = None,
+           at: float | None = None) -> None:
+    """Record a named absolute timestamp (``at`` overrides "now" for
+    events measured elsewhere, e.g. the profiler window's stop time);
+    flushed to KFT_PHASES_PATH so the operator/bench can decompose
+    submit->first-step into pod spawn / imports / rendezvous / compile /
+    step 1 (BASELINE.md row 2).
 
     Two transports behind the one env value, mirroring KFT_HEARTBEAT_FILE:
     a filesystem path (shared-fs backends) writes an atomic JSON file; an
@@ -31,7 +34,7 @@ def _phase(phases: dict, name: str, extra: dict | None = None) -> None:
     each extra key lands in its own ``{path}.{key}.{process}`` file."""
     import time
 
-    phases[name] = time.time()
+    phases[name] = time.time() if at is None else float(at)
     path = os.environ.get("KFT_PHASES_PATH")
     if not path:
         return
@@ -215,6 +218,19 @@ def main() -> int:
                      checkpoint_every=int(
                          os.environ.get("KFT_CHECKPOINT_EVERY", "100")),
                      on_step=_first_step, already_resumed=resumed)
+        # profiler artifact stamp: fit() honored KFT_PROFILE_DIR /
+        # KFT_PROFILE_STEPS from the pod env (training/loop contract).
+        # Stamped ONLY when the window actually ran (result.profile), at
+        # the REAL start/stop wall times — the job-trace worker.profile
+        # span must cover the profiled window, not end-of-training, and
+        # a run that never reached the window must not report a phantom
+        # artifact. The trace-dir path rides as a string stamp, so the
+        # operator's job trace carries WHERE the profile landed as a
+        # span attr — no log scraping.
+        if result.profile is not None:
+            phases["profile_dir"] = result.profile["dir"]
+            phases["profile_start"] = result.profile["t_start"]
+            _phase(phases, "profile_done", at=result.profile["t_stop"])
         incarnation = os.environ.get("KFT_WORKER_INCARNATION", "0")
         print(f"worker {world.process_id}: trained to step "
               f"{result.final_step} (resumed_from={result.resumed_from}, "

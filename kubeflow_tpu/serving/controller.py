@@ -32,6 +32,7 @@ from typing import Optional
 from kubeflow_tpu.controller.cluster import (
     Cluster, Pod, PodPhase, Service, create_and_admit,
 )
+from kubeflow_tpu.obs.histogram import Histogram
 from kubeflow_tpu.serving.types import (
     InferenceService, ModelFormat, ServingRuntime,
 )
@@ -652,7 +653,21 @@ class CanaryGate:
         self.min_requests = int(min_requests)
         self.requests = 0
         self.errors = 0
-        self._latencies: list[float] = []
+        # log-bucketed histogram (obs/histogram.py), NOT a raw list: a
+        # long-lived canary split observes every request, and an
+        # unbounded list grew without limit for the life of the gate.
+        # O(buckets) memory at any observation count; p95 reads as the
+        # holding bucket's upper bound — conservative (never understates
+        # the latency). The SLO threshold itself is added as a bucket
+        # bound, so the decision is EXACT at the boundary: a true p95
+        # at or under the threshold can never read as over it through
+        # bucket rounding (which would roll back a healthy canary).
+        from kubeflow_tpu.obs.histogram import DEFAULT_BUCKETS
+
+        bounds = set(DEFAULT_BUCKETS)
+        if self.max_p95_latency_s > 0:
+            bounds.add(self.max_p95_latency_s)
+        self._latency_hist = Histogram(buckets=sorted(bounds))
         self._lock = threading.Lock()
 
     def observe(self, ok: bool, latency_s: float = 0.0) -> None:
@@ -661,14 +676,10 @@ class CanaryGate:
             if not ok:
                 self.errors += 1
             else:
-                self._latencies.append(float(latency_s))
+                self._latency_hist.observe(float(latency_s))
 
     def p95_latency(self) -> float:
-        with self._lock:
-            if not self._latencies:
-                return 0.0
-            xs = sorted(self._latencies)
-            return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+        return self._latency_hist.percentile(0.95)
 
     def decide(self) -> Optional[str]:
         with self._lock:

@@ -326,6 +326,13 @@ class LLMModel(Model):
             # bandwidth quietly lost — it must be visible on /metrics
             "kernel_downgrades_total": eng.kernel_downgrades,
             "sched": eng.scheduler_stats(),
+            # request-latency distributions (obs/histogram.py): bucket
+            # snapshots + p50/p95/p99 per family. The server renders
+            # these as the kft_model_request_{ttft,itl,e2e}_seconds
+            # Prometheus histograms on /metrics; this JSON view is what
+            # bench/autoscaler read without parsing exposition text
+            "request_histograms": {
+                k: h.snapshot() for k, h in eng.request_hists.items()},
         }
         if self.load_seconds is not None:
             # replica-add decomposition (fleet bench): model/engine build
@@ -367,10 +374,15 @@ class LLMModel(Model):
         for prompt in prompts:
             self.engine.validate_prompt(prompt, sampling)
         stop = self._stop_strings(p)
+        # trace context: the router/server span's traceparent rides the
+        # request parameters; every row's queue span chains under it so
+        # the whole request yields ONE trace across processes
+        traceparent = p.get("traceparent")
         reqs = []
         with self._wake:
             for prompt in prompts:
-                reqs.append(self.engine.add_request(prompt, sampling))
+                reqs.append(self.engine.add_request(
+                    prompt, sampling, trace=traceparent))
             self._wake.notify_all()
         matchers: dict[int, _StopMatcher] = {}
         fed: dict[int, int] = {}
@@ -466,7 +478,8 @@ class LLMModel(Model):
         with self._wake:
             # add_request validates eagerly (prompt + KV reservation) in
             # THIS thread — a bad request raises before any 200 commits
-            req = self.engine.add_request(prompt, sampling)
+            req = self.engine.add_request(prompt, sampling,
+                                          trace=p.get("traceparent"))
             self._wake.notify_all()
         return self._stream_events(req, text_out, stop,
                                    want_logprobs=bool(

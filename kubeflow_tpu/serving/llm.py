@@ -21,6 +21,7 @@ import dataclasses
 import itertools
 import logging
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 import jax
@@ -28,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.models import llama
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.obs.histogram import Histogram
 from kubeflow_tpu.serving.scheduler import (
     SchedulerConfig, StepScheduler, ceil_pow2,
 )
@@ -75,6 +78,17 @@ class GenRequest:
     # then reads as a clean "stop" finish, not a client disconnect
     stop_matched: bool = False
     slot: Optional[int] = None
+    # observability: the request's trace context ((trace_id, span_id) of
+    # its queue span — decode/prefill spans attribute to it), wall-clock
+    # latency marks (enqueue/first-token/last-commit/done) feeding the
+    # kft_model_request_{ttft,itl,e2e}_seconds histograms, and the live
+    # span handles the engine closes as the request advances
+    trace: Optional[tuple] = None
+    t_enqueue: float = 0.0
+    t_first_token: float = 0.0
+    t_last_commit: float = 0.0
+    t_done: float = 0.0
+    spans: dict = dataclasses.field(default_factory=dict)
 
     @property
     def finish_reason(self) -> str:
@@ -182,7 +196,8 @@ class LLMEngine:
                  decode_pipeline: bool = True,
                  kernel: str = "auto",
                  mesh=None,
-                 scheduler: Optional[SchedulerConfig] = None):
+                 scheduler: Optional[SchedulerConfig] = None,
+                 obs: Optional[obs_trace.SpanCollector] = None):
         from kubeflow_tpu.serving.paged_kv import (
             PagedKV, _lm_head as lm_head_fn, paged_prefill_chunk
             as paged_prefill_chunk_fn, paged_verify_step
@@ -288,6 +303,13 @@ class LLMEngine:
         # and the counter set /metrics exports
         self.sched = StepScheduler(scheduler, default_budget=self.buckets[-1],
                                    decode_chunk=self.decode_chunk)
+        # observability (obs/): every request yields a queue span,
+        # per-prefill-chunk spans and per-decode-dispatch spans into the
+        # process collector, plus the three request-latency histograms
+        # /metrics serves as kft_model_request_{ttft,itl,e2e}_seconds
+        self.obs = obs or obs_trace.collector()
+        self.request_hists = {"ttft": Histogram(), "itl": Histogram(),
+                              "e2e": Histogram()}
         self.paged.prefix_cache = self.sched.cfg.radix_cache
         # in-flight chunked prefills, slot -> state (insertion order = FIFO)
         self._chunked: dict[int, _ChunkedPrefill] = {}
@@ -470,11 +492,22 @@ class LLMEngine:
                     f"{usable}; raise kv_num_blocks or lower max_tokens")
 
     def add_request(self, prompt: Sequence[int],
-                    sampling: Optional[SamplingParams] = None) -> GenRequest:
+                    sampling: Optional[SamplingParams] = None,
+                    trace: Optional[str] = None) -> GenRequest:
+        """``trace``: an incoming W3C traceparent (router/server span) —
+        the request's queue span roots under it, so the full
+        router -> server -> queue -> prefill -> decode chain shares one
+        trace id across processes."""
         sampling = sampling or SamplingParams()
         self.validate_prompt(prompt, sampling)
         req = GenRequest(id=next(self._ids), prompt=list(map(int, prompt)),
                          sampling=sampling)
+        req.t_enqueue = time.time()
+        qspan = self.obs.start(
+            "request.queue", parent=trace,
+            attrs={"request_id": req.id, "prompt_tokens": len(req.prompt)})
+        req.spans["queue"] = qspan
+        req.trace = (qspan.trace_id, qspan.span_id)
         with self._lock:
             self._waiting.append(req)
         return req
@@ -489,9 +522,63 @@ class LLMEngine:
             r.aborted = True
             r.done = True
             ids.add(r.id)
+            # still-open spans (a queue span of a never-admitted request)
+            # close NOW with the abort attr — an aborted request must
+            # leave a coherent trace, never a dangling open span
+            for sp in r.spans.values():
+                if sp.t1 is None:
+                    self.obs.end(sp, aborted=True)
         with self._lock:
             self._waiting = [r for r in self._waiting if r.id not in ids]
             self._aborted.update(ids)
+
+    # ---------------- observability hooks ----------------
+
+    def _end_queue_span(self, req: GenRequest, slot: int,
+                        n_shared: int) -> None:
+        """The queue span ends at slot assignment (admission), not at
+        first token — TTFT minus queue time is the prefill cost."""
+        sp = req.spans.get("queue")
+        if sp is not None and sp.t1 is None:
+            self.obs.end(sp, slot=slot, shared_blocks=n_shared)
+
+    def _dispatch_span(self, name: str, reqs: Sequence[GenRequest],
+                       **attrs) -> Any:
+        """Engine-level span (decode/verify/batched-prefill dispatch):
+        owned by ONE trace when every covered request shares it, else
+        top-level with the participating ids in ``attrs.trace_ids`` so
+        per-trace filtering still finds it."""
+        tids = sorted({r.trace[0] for r in reqs if r.trace})
+        kw: dict = {}
+        if len(tids) == 1:
+            kw["trace_id"] = tids[0]
+            if len(reqs) == 1 and reqs[0].spans.get("queue") is not None:
+                kw["parent"] = reqs[0].spans["queue"]
+        elif tids:
+            attrs["trace_ids"] = tids
+        return self.obs.start(name, attrs=attrs, **kw)
+
+    def _note_request_latency(self, req: GenRequest, n_new: int) -> None:
+        """Feed the request histograms after committing ``n_new`` tokens
+        in one read-back. The first token closes TTFT; later commits
+        spread the read-back gap evenly over the chunk's tokens (the
+        honest per-token latency of multistep decode — tokens inside one
+        dispatch arrive together, so per-commit wall deltas would read
+        as zero)."""
+        if n_new <= 0:
+            return
+        now = time.time()
+        if req.t_first_token == 0.0:
+            req.t_first_token = now
+            if req.t_enqueue:
+                self.request_hists["ttft"].observe(now - req.t_enqueue)
+            n_new -= 1
+        if n_new > 0 and req.t_last_commit:
+            gap = max(0.0, now - req.t_last_commit) / n_new
+            itl = self.request_hists["itl"]
+            for _ in range(n_new):
+                itl.observe(gap)
+        req.t_last_commit = now
 
     def has_work(self) -> bool:
         with self._lock:
@@ -599,6 +686,9 @@ class LLMEngine:
                 self._min_deterministic_remaining(),
                 pressure=bool(self._waiting))
             self.sched.note_decode_dispatch(chunk_len)
+            dspan = self._dispatch_span(
+                "decode.step", [r for _, r in self._active.items()],
+                chunk_len=chunk_len, batch=len(self._active))
             self._rng, step_rng = jax.random.split(self._rng)
             # static: an all-greedy batch skips the per-step full-vocab
             # sort (two compile variants total)
@@ -621,7 +711,7 @@ class LLMEngine:
                     kernel=self.kernel, chunk_len=chunk_len)
             new_inflight = {
                 "toks": toks, "lps": lps, "next": next_tok,
-                "chunk_len": chunk_len,
+                "chunk_len": chunk_len, "span": dspan,
                 # snapshot: tokens belong to the requests active at
                 # DISPATCH time — a slot may host a new request by the
                 # time these arrays are read back
@@ -695,6 +785,9 @@ class LLMEngine:
         already host a newer request when retiring from a stale
         dispatch snapshot)."""
         req.done = True
+        req.t_done = time.time()
+        if not req.aborted and req.t_enqueue:
+            self.request_hists["e2e"].observe(req.t_done - req.t_enqueue)
         if self._active.get(slot) is req:
             del self._active[slot]
             self.paged.release(slot)
@@ -716,18 +809,32 @@ class LLMEngine:
         lps = np.asarray(inflight["lps"])
         self.steps += toks.shape[0]
         finished = []
+        committed_total = 0
         for slot, req in inflight["snapshot"]:
             if req.done:
                 continue               # aborted/retired after dispatch
+            n0 = len(req.generated)
+            done = False
             for t in range(toks.shape[0]):
                 if self._commit_token(req, slot, int(toks[t, slot]),
                                       float(lps[t, slot])):
                     # overshoot tokens beyond this point are trimmed (never
                     # appended); their cache writes went to this slot's own
                     # blocks / scratch and are ordered before any reuse
-                    finished.append(req)
-                    self._retire(req, slot)
+                    done = True
                     break
+            n_new = len(req.generated) - n0
+            committed_total += n_new
+            self._note_request_latency(req, n_new)
+            if done:
+                finished.append(req)
+                self._retire(req, slot)
+        span = inflight.get("span")
+        if span is not None:
+            # the decode span covers dispatch -> read-back (pipelined:
+            # device compute + the host overlap it bought)
+            self.obs.end(span, tokens_committed=committed_total,
+                         device_steps=int(toks.shape[0]))
         return finished
 
     def _spec_step(self) -> list[GenRequest]:
@@ -746,6 +853,7 @@ class LLMEngine:
         bs = self.paged.block_size
         drafts: dict[int, list[int]] = {}
         k_max = 0
+        vspan = None
         for slot, req in self._active.items():
             # deterministic remaining budget: drafts past it can never
             # commit (the commit loop stops at max_tokens/max_seq), so
@@ -770,6 +878,10 @@ class LLMEngine:
             limit[slot] = len(self.paged.slot_blocks(slot)) * bs
         self.sched.note_spec_dispatch(
             sum(len(d) for d in drafts.values()))
+        vspan = self._dispatch_span(
+            "decode.verify", [r for _, r in self._active.items()],
+            width=width, drafted=sum(len(d) for d in drafts.values()),
+            batch=len(self._active))
         toks, lps, self.cache = self._verify(
             self.params, jnp.asarray(tokens), self.cache,
             jnp.asarray(self._dispatch_tables()), jnp.asarray(limit))
@@ -777,6 +889,7 @@ class LLMEngine:
         lps = np.asarray(lps)
         self.steps += 1
         finished = []
+        committed_total = 0
         new_len = np.zeros((self.max_batch,), np.int32)
         for slot, req in list(self._active.items()):
             if req.done:
@@ -805,6 +918,8 @@ class LLMEngine:
             # would overstate the drafter on eos-heavy traffic
             self.sched.note_spec_result(min(accepted, n_appended),
                                         n_appended)
+            committed_total += n_appended
+            self._note_request_latency(req, n_appended)
             if done:
                 finished.append(req)
                 self._retire(req, slot)
@@ -812,6 +927,8 @@ class LLMEngine:
                 # committed length only — rejected rows stay beyond it
                 new_len[slot] = len(req.prompt) + len(req.generated) - 1
         self.cache = self._set_lens(self.cache, jnp.asarray(new_len))
+        if vspan is not None:
+            self.obs.end(vspan, tokens_committed=committed_total)
         return finished
 
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -849,6 +966,9 @@ class LLMEngine:
         req = st.req
         L = len(req.prompt)
         W = self._chunk_width
+        pspan = self._dispatch_span(
+            "prefill.chunk", [req], slot=slot, offset=st.offset,
+            width=W, prompt_tokens=L)
         piece = np.zeros((1, W), np.int32)
         part = req.prompt[st.offset:st.offset + W]
         piece[0, :len(part)] = part
@@ -858,6 +978,7 @@ class LLMEngine:
             jnp.int32(st.share_len))
         st.offset += W
         self.sched.note_prefill_chunk(W)
+        self.obs.end(pspan, final=st.offset >= L)
         # publish completed read-only blocks: every position < offset is
         # written and its write DISPATCHED, so a later sharer's reads are
         # device-ordered behind the content
@@ -943,6 +1064,7 @@ class LLMEngine:
                 self._free.append(slot)
                 self.sched.note_stall()
                 return
+            self._end_queue_span(req, slot, n_shared)
             if chunked:
                 self._start_chunked(req, slot, n_shared)
                 spent = self._chunked_phase(interleave, budget, spent)
@@ -975,6 +1097,7 @@ class LLMEngine:
                     self._free.append(s2)
                     self.sched.note_stall()
                     break
+                self._end_queue_span(nxt, s2, ns2)
                 batch.append((nxt, s2, ns2))
             self._admit_prefill_batch(batch, bucket)
             self.sched.note_admitted(len(batch))
@@ -1006,6 +1129,9 @@ class LLMEngine:
             slots[i] = slot
         scratch = llama.init_cache(self.cfg, width, bucket)
         self.prefill_dispatches += 1
+        pspan = self._dispatch_span(
+            "prefill.batch", [r for r, _, _ in batch],
+            bucket=bucket, batch=len(batch))
         logits, filled = self._prefill(
             self.params, jnp.asarray(toks), jnp.asarray(lengths), scratch)
         self.cache = self._insert_batch(
@@ -1013,6 +1139,7 @@ class LLMEngine:
             jnp.asarray(lengths), jnp.asarray(slots))
         tok, lp = self._sample_rows(logits, [r for r, _, _ in batch],
                                     width=width)
+        self.obs.end(pspan)
         for i, (req, slot, _) in enumerate(batch):
             self._post_admit(req, slot, int(tok[i]), float(lp[i]))
 
@@ -1041,5 +1168,7 @@ class LLMEngine:
         req.slot = slot
         self._fresh[slot] = True       # override any device token carry
         self._active[slot] = req
-        if self._commit_token(req, slot, first_tok, first_lp):
+        done = self._commit_token(req, slot, first_tok, first_lp)
+        self._note_request_latency(req, 1)       # TTFT closes here
+        if done:
             self._retire(req, slot)

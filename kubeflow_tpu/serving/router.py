@@ -24,6 +24,7 @@ import random
 import threading
 from typing import Callable, Optional, Union
 
+from kubeflow_tpu.obs import trace as obs_trace
 from kubeflow_tpu.serving.model import Model, ModelRepository
 from kubeflow_tpu.serving.protocol import InferRequest, InferResponse
 from kubeflow_tpu.serving.server import InferenceClient
@@ -136,9 +137,13 @@ class FleetRouter:
 
     def __init__(self, *, block_size: int = 16, policy: str = "affine",
                  spill_queue_depth: int = 4, vnodes: int = 64,
-                 load_of: Optional[Callable] = None, seed: int = 0):
+                 load_of: Optional[Callable] = None, seed: int = 0,
+                 obs: Optional[obs_trace.SpanCollector] = None):
         if policy not in ("affine", "random"):
             raise ValueError(f"policy={policy!r} (want affine|random)")
+        # span collector: route() roots the request trace here (or chains
+        # under an incoming traceparent) and propagates context downstream
+        self.obs = obs or obs_trace.collector()
         self.block_size = int(block_size)
         self.policy = policy
         self.spill_queue_depth = int(spill_queue_depth)
@@ -232,15 +237,35 @@ class FleetRouter:
     def route(self, request: InferRequest, prompt) -> InferResponse:
         """pick + call, for callers fronting real backends. A replica
         removed between pick and call (concurrent scale-down) re-picks
-        onto the surviving fleet instead of failing the request."""
+        onto the surviving fleet instead of failing the request.
+
+        Tracing: this is where the request trace usually ROOTS — a
+        router span opens (chained under any incoming traceparent),
+        its context propagates to the backend via the ``traceparent``
+        parameter + HTTP header, and the span closes with the replica
+        that served (or the error) so a re-pick after a vanished
+        replica is one coherent span, never an orphan chain."""
+        span = self.obs.start(
+            "router.route", parent=request.parameters.get("traceparent"),
+            attrs={"policy": self.policy,
+                   "prompt_tokens": len(prompt)})
+        request.parameters["traceparent"] = span.traceparent()
         name = None
-        for _ in range(2):
-            name = self.pick(prompt, request_id=request.id)
-            with self._lock:
-                backend = self.replicas.get(name)
-            if backend is not None:
-                return _call(backend, request)
-        raise KeyError(f"replica {name!r} vanished during routing")
+        try:
+            for attempt in range(2):
+                name = self.pick(prompt, request_id=request.id)
+                with self._lock:
+                    backend = self.replicas.get(name)
+                if backend is not None:
+                    resp = _call(backend, request)
+                    self.obs.end(span, replica=name, repicked=attempt)
+                    return resp
+            raise KeyError(f"replica {name!r} vanished during routing")
+        except BaseException as e:
+            if span.t1 is None:
+                self.obs.end(span, replica=name,
+                             error=type(e).__name__)
+            raise
 
     def snapshot(self) -> dict:
         with self._lock:

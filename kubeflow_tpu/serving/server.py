@@ -16,6 +16,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib import request as urlrequest
 
+from kubeflow_tpu.obs import expo as obs_expo
+from kubeflow_tpu.obs import trace as obs_trace
 from kubeflow_tpu.serving.model import (
     Model, ModelMissing, ModelNotReady, ModelRepository,
 )
@@ -38,13 +40,18 @@ class ModelServer:
     container's entrypoint."""
 
     def __init__(self, repository: Optional[ModelRepository] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 obs: Optional[obs_trace.SpanCollector] = None):
         self.repository = repository or ModelRepository()
         self.request_count = 0
         self.error_count = 0
         # concurrency gauge: the autoscaler's scale signal (KPA role)
         self.in_flight = 0
         self._gauge_lock = threading.Lock()
+        # span collector: every infer/stream handler opens a server span
+        # chained to the caller's traceparent header (router -> server ->
+        # engine is one trace)
+        self.obs = obs or obs_trace.collector()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -97,41 +104,7 @@ class ModelServer:
                         for n in outer.repository.names()
                     ])
                 if path == "/metrics":
-                    text = (
-                        f"kft_requests_total {outer.request_count}\n"
-                        f"kft_request_errors_total {outer.error_count}\n"
-                        # minus this scrape itself
-                        f"kft_requests_in_flight {max(0, outer.in_flight - 1)}\n"
-                    )
-                    # per-model engine gauges (models exposing stats());
-                    # tolerate hot unload racing the scrape. A nested dict
-                    # is a counter FAMILY (e.g. the step scheduler's
-                    # "sched" set) flattened to kft_model_<family>_<k> —
-                    # occupancy / queue-depth / prefix-hit / preempt
-                    # counters the serving controller autoscales on
-                    for mname in outer.repository.names():
-                        try:
-                            mdl = outer.repository.get(mname)
-                            stats = getattr(mdl, "stats", dict)() or {}
-                        except ModelMissing:
-                            continue
-                        flat = []
-                        for k, v in stats.items():
-                            if isinstance(v, dict):
-                                flat.extend((f"{k}_{k2}", v2)
-                                            for k2, v2 in v.items())
-                            else:
-                                flat.append((k, v))
-                        for k, v in flat:
-                            # numeric gauges only: stats() may carry
-                            # strings (e.g. the depot outcome) for the
-                            # JSON stats endpoint — a non-numeric value
-                            # would corrupt the prometheus exposition
-                            if not isinstance(v, (int, float, bool)):
-                                continue
-                            text += (f'kft_model_{k}'
-                                     f'{{model="{mname}"}} {v}\n')
-                    body = text.encode()
+                    body = outer._render_metrics().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(body)))
@@ -213,6 +186,7 @@ class ModelServer:
                     return self._json(404, {"error": str(e)})
 
             def _infer(self, name: str, v1: bool):
+                span = None
                 try:
                     model = outer.repository.get(name)
                     body = self._read_body()
@@ -220,18 +194,40 @@ class ModelServer:
                         req = InferRequest.from_v1(name, body)
                     else:
                         req = InferRequest.from_dict(name, body)
+                    # trace propagation: the W3C traceparent header (or
+                    # the request-parameter fallback for clients that
+                    # can't set headers) chains this server span under
+                    # the caller's; the model continues the chain via
+                    # the parameter we overwrite with OUR context
+                    incoming = (self.headers.get(
+                        obs_trace.TRACEPARENT_HEADER)
+                        or req.parameters.get("traceparent"))
+                    span = outer.obs.start(
+                        "server.infer", parent=incoming,
+                        attrs={"model": name,
+                               "protocol": "v1" if v1 else "v2"})
+                    req.parameters["traceparent"] = span.traceparent()
                     resp = model(req)
+                    outer.obs.end(span)
                     return self._json(
                         200, resp.to_v1() if v1 else resp.to_dict())
                 except ModelMissing as e:
                     outer.error_count += 1
+                    self._end_err(span, e)
                     return self._json(404, {"error": str(e)})
                 except ModelNotReady as e:
                     outer.error_count += 1
+                    self._end_err(span, e)
                     return self._json(503, {"error": str(e)})
                 except Exception as e:
                     outer.error_count += 1
+                    self._end_err(span, e)
                     return self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+            @staticmethod
+            def _end_err(span, e):
+                if span is not None and span.t1 is None:
+                    outer.obs.end(span, error=type(e).__name__)
 
             def _stream(self, name: str):
                 """SSE token streaming (every LLM server's generate path):
@@ -245,8 +241,23 @@ class ModelServer:
                             400, {"error": f"{name!r} is not a generative "
                                            "model"})
                     body = self._read_body()
-                    gen = model.generate_stream(
-                        body.get("inputs", ""), body.get("parameters"))
+                    params = dict(body.get("parameters") or {})
+                    # server span for the whole stream (setup -> [DONE]),
+                    # chained under the caller's header or param context;
+                    # the engine chains its queue span under OURS
+                    incoming = (self.headers.get(
+                        obs_trace.TRACEPARENT_HEADER)
+                        or params.get("traceparent"))
+                    span = outer.obs.start(
+                        "server.generate_stream", parent=incoming,
+                        attrs={"model": name})
+                    params["traceparent"] = span.traceparent()
+                    try:
+                        gen = model.generate_stream(
+                            body.get("inputs", ""), params)
+                    except BaseException as e:
+                        outer.obs.end(span, error=type(e).__name__)
+                        raise
                 except ModelMissing as e:
                     outer.error_count += 1
                     return self._json(404, {"error": str(e)})
@@ -263,19 +274,26 @@ class ModelServer:
                 self.send_header("Connection", "close")
                 self.close_connection = True
                 self.end_headers()
+                events = 0
                 try:
                     for event in gen:
+                        events += 1
                         self.wfile.write(
                             b"data: " + json.dumps(event).encode() + b"\n\n")
                         self.wfile.flush()
                     self.wfile.write(b"data: [DONE]\n\n")
+                    outer.obs.end(span, events=events)
                 except (BrokenPipeError, ConnectionResetError):
                     gen.close()        # aborts the request, frees the slot
+                    outer.obs.end(span, events=events,
+                                  aborted="client disconnect")
                 except Exception as e:
                     # headers are gone: surface mid-stream failures
                     # (timeouts etc.) as an SSE error event, never a
                     # silently truncated stream
                     outer.error_count += 1
+                    outer.obs.end(span, events=events,
+                                  error=type(e).__name__)
                     try:
                         self.wfile.write(
                             b"data: " + json.dumps(
@@ -302,6 +320,58 @@ class ModelServer:
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
 
+    def _render_metrics(self) -> str:
+        """The /metrics body, rendered through the ONE shared exposition
+        helper (obs/expo.py): # HELP/# TYPE per family, counters typed by
+        their _total/_sum/_count suffix, and each model's
+        ``request_histograms`` stats family expanded into real Prometheus
+        histograms (kft_model_request_{ttft,itl,e2e}_seconds)."""
+        counters: dict[str, list] = {
+            "kft_requests_total": [(None, self.request_count)],
+            "kft_request_errors_total": [(None, self.error_count)],
+        }
+        gauges: dict[str, list] = {
+            # minus this scrape itself
+            "kft_requests_in_flight": [(None, max(0, self.in_flight - 1))],
+        }
+        hists: dict[str, list] = {}
+        # per-model engine stats (models exposing stats()); tolerate hot
+        # unload racing the scrape. A nested dict is a FAMILY (e.g. the
+        # step scheduler's "sched" set) flattened to kft_model_<fam>_<k>;
+        # non-numeric values (depot outcome strings) feed only the JSON
+        # stats endpoint, never the exposition
+        for mname in self.repository.names():
+            try:
+                mdl = self.repository.get(mname)
+                stats = getattr(mdl, "stats", dict)() or {}
+            except ModelMissing:
+                continue
+            label = f'model="{mname}"'
+            for hname, snap in (stats.pop("request_histograms", None)
+                                or {}).items():
+                hists.setdefault(
+                    f"kft_model_request_{hname}_seconds",
+                    []).append((label, snap))
+            flat = []
+            for k, v in stats.items():
+                if isinstance(v, dict):
+                    flat.extend((f"{k}_{k2}", v2) for k2, v2 in v.items())
+                else:
+                    flat.append((k, v))
+            for k, v in flat:
+                if not isinstance(v, (int, float, bool)):
+                    continue
+                fam = f"kft_model_{k}"
+                target = (counters
+                          if fam.endswith(obs_expo.COUNTER_SUFFIXES)
+                          else gauges)
+                target.setdefault(fam, []).append((label, float(v)))
+        families = (
+            [(n, "counter", s) for n, s in counters.items()]
+            + [(n, "gauge", s) for n, s in gauges.items()]
+            + [(n, "histogram", s) for n, s in hists.items()])
+        return obs_expo.render_exposition(families)
+
     def start(self) -> "ModelServer":
         self._thread.start()
         return self
@@ -322,10 +392,12 @@ class InferenceClient:
         self.url = url.rstrip("/")
         self.timeout = timeout
 
-    def _post(self, path: str, payload: dict) -> dict:
+    def _post(self, path: str, payload: dict,
+              headers: Optional[dict] = None) -> dict:
         req = urlrequest.Request(
             self.url + path, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers={"Content-Type": "application/json",
+                     **(headers or {})}, method="POST")
         with urlrequest.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read())
 
@@ -357,8 +429,14 @@ class InferenceClient:
         return self._post(f"/v1/models/{model}:predict", body)
 
     def infer(self, request: InferRequest) -> InferResponse:
+        # propagate trace context as the W3C header too (proxies that
+        # strip unknown body params still chain the trace)
+        headers = {}
+        tp = request.parameters.get("traceparent")
+        if tp:
+            headers["traceparent"] = tp
         out = self._post(f"/v2/models/{request.model_name}/infer",
-                         request.to_dict())
+                         request.to_dict(), headers=headers)
         return InferResponse.from_dict(out)
 
     def explain_v1(self, model: str, instances: list) -> dict:

@@ -27,6 +27,11 @@ class FitResult:
     final_step: int
     resumed_from: Optional[int]
     last_metrics: dict
+    # set iff the jax.profiler window actually ran: {"dir", "t_start",
+    # "t_stop"} wall times of start_trace/stop_trace — what worker_check
+    # stamps into the phase report (a run that never reached the window
+    # must not report a phantom profile artifact)
+    profile: Optional[dict] = None
 
 
 def post_heartbeat(url: str, step=None, warning=None,
@@ -138,6 +143,29 @@ class Heartbeat:
                 post_heartbeat(self.path, step=step, warning=warning)
 
 
+def profile_from_env(env=None) -> tuple[Optional[str],
+                                        Optional[tuple[int, int]]]:
+    """The pod env contract for the jax.profiler toggle:
+    KFT_PROFILE_DIR names the trace output directory (unset = profiling
+    off) and KFT_PROFILE_STEPS is "start:stop" (or "start,stop") step
+    bounds for the profiled window. Returns (dir, steps) with None for
+    whatever is unset/malformed — a bad value must never fail a job over
+    an optional profile."""
+    env = os.environ if env is None else env
+    profile_dir = env.get("KFT_PROFILE_DIR") or None
+    steps = None
+    raw = env.get("KFT_PROFILE_STEPS") or ""
+    if raw:
+        try:
+            a, b = raw.replace(",", ":").split(":")
+            steps = (int(a), int(b))
+            if steps[0] >= steps[1] or steps[0] < 0:
+                steps = None
+        except ValueError:
+            steps = None
+    return profile_dir, steps
+
+
 def restore_latest(trainer: Trainer, mgr: CheckpointManager):
     """Restore the newest checkpoint into ``trainer`` (params/opt_state
     re-placed on the template's shardings, step advanced). Returns the
@@ -190,7 +218,7 @@ def fit(
     metrics_every: int = 10,
     heartbeat: Optional[Heartbeat] = None,
     profile_dir: Optional[str] = None,
-    profile_steps: tuple[int, int] = (10, 20),
+    profile_steps: Optional[tuple[int, int]] = None,
     on_step: Optional[Callable[[int, dict], None]] = None,
     already_resumed: Optional[int] = None,
 ) -> FitResult:
@@ -215,6 +243,13 @@ def fit(
     # latency metric without any explicit wiring in user code
     if heartbeat is None and os.environ.get("KFT_HEARTBEAT_FILE"):
         heartbeat = Heartbeat(os.environ["KFT_HEARTBEAT_FILE"])
+    # profiler toggle rides the pod env the same way (KFT_PROFILE_DIR /
+    # KFT_PROFILE_STEPS): explicit arguments win, env fills the gaps
+    env_dir, env_steps = profile_from_env()
+    if profile_dir is None:
+        profile_dir = env_dir
+    if profile_steps is None:
+        profile_steps = env_steps or (10, 20)
 
     # a caller that already initialized (e.g. worker_check's precompile
     # phase, which needs live state to lower the step) keeps its state —
@@ -248,6 +283,7 @@ def fit(
         batches = itertools.islice(iter(batches), resumed_from, None)
 
     profiling = False
+    profile_info: Optional[dict] = None
     last = {}
     for batch in batches:
         if trainer.step >= max_steps:
@@ -257,6 +293,7 @@ def fit(
         if profile_dir and not profiling and step == profile_steps[0]:
             jax.profiler.start_trace(profile_dir)
             profiling = True
+            profile_info = {"dir": profile_dir, "t_start": time.time()}
         m = trainer.train_step(batch)
         if profiling and trainer.step >= profile_steps[1]:
             # device_get, not block_until_ready: the latter is a no-op on
@@ -265,6 +302,7 @@ def fit(
             float(jax.device_get(m["loss"]))
             jax.profiler.stop_trace()
             profiling = False
+            profile_info["t_stop"] = time.time()
 
         last = {k: float(v) for k, v in m.items()
                 if hasattr(v, "__float__")}
@@ -283,6 +321,7 @@ def fit(
 
     if profiling:
         jax.profiler.stop_trace()
+        profile_info["t_stop"] = time.time()
     if mgr is not None:
         # final save — unless this exact step is already on disk (the
         # in-loop save fired on it, or a resumed run trained 0 steps);
@@ -297,4 +336,7 @@ def fit(
     if metrics is not None and last:
         metrics.write(trainer.step, **last)
     return FitResult(final_step=trainer.step, resumed_from=resumed_from,
-                     last_metrics=last)
+                     last_metrics=last,
+                     profile=(profile_info
+                              if profile_info and "t_stop" in profile_info
+                              else None))

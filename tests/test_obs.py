@@ -1,0 +1,754 @@
+"""Observability suite (ISSUE 14): span collector semantics + races,
+histogram percentiles + bounded memory (the CanaryGate fix), the shared
+Prometheus exposition lint against BOTH /metrics surfaces, end-to-end
+trace propagation (router -> HTTP server -> engine) including the
+failure paths, operator job traces, and the profiler env wiring."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.obs import expo, export, trace
+from kubeflow_tpu.obs.histogram import Histogram, log_buckets
+
+# ------------------------------------------------------------- trace --
+
+
+def test_traceparent_roundtrip_and_rejects_malformed():
+    tid, sid = trace.new_trace_id(), trace.new_span_id()
+    assert trace.parse_traceparent(
+        trace.format_traceparent(tid, sid)) == (tid, sid)
+    for bad in (None, "", "junk", "00-zz-yy-01", 42,
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace
+                "00-" + "a" * 31 + "-" + "1" * 16 + "-01"):  # short trace
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_collector_parent_chain_and_context_manager():
+    c = trace.SpanCollector(capacity=16, proc="t")
+    with c.span("root") as root:
+        with c.span("child", parent=root) as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+        # traceparent-string parents work identically (the HTTP path)
+        s = c.start("http-child", parent=root.traceparent())
+        assert s.trace_id == root.trace_id
+        assert s.parent_id == root.span_id
+        c.end(s)
+    snap = c.snapshot()
+    assert [x["name"] for x in snap] == ["child", "http-child", "root"]
+    assert all(x["t1"] is not None for x in snap)
+    assert not export.validate_trace(c.spans_for(root.trace_id))
+
+
+def test_collector_ring_is_bounded():
+    c = trace.SpanCollector(capacity=8)
+    for i in range(30):
+        c.end(c.start(f"s{i}"))
+    snap = c.snapshot()
+    assert len(snap) == 8
+    assert c.dropped == 22
+    # oldest overwritten, newest retained, order preserved
+    assert [s["name"] for s in snap] == [f"s{i}" for i in range(22, 30)]
+
+
+def test_collector_hammered_from_8_threads():
+    c = trace.SpanCollector(capacity=256)
+    errors = []
+
+    def worker(k):
+        try:
+            for i in range(500):
+                with c.span(f"w{k}.{i}", attrs={"k": k}):
+                    pass
+        except Exception as e:          # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert c.open_count == 0
+    snap = c.snapshot()
+    assert len(snap) == 256                       # ring full, not grown
+    assert c.dropped == 8 * 500 - 256
+    assert all(s["t1"] is not None for s in snap)
+
+
+def test_end_is_idempotent_under_race():
+    """Review regression: two racing enders (abort thread vs engine
+    step thread, both passing an unsynchronized ``t1 is None`` check)
+    append exactly ONE ring entry."""
+    c = trace.SpanCollector(capacity=16)
+    s = c.start("raced")
+    c.end(s, winner=True)
+    c.end(s, loser=True)                  # double end: dropped
+    snap = c.snapshot()
+    assert len(snap) == 1
+    assert snap[0]["attrs"] == {"winner": True}
+    assert c.open_count == 0
+
+    barrier = threading.Barrier(8)
+    spans = [c.start(f"r{i}") for i in range(4)]
+
+    def hammer(k):
+        barrier.wait()
+        for s in spans:
+            c.end(s, k=k)
+
+    threads = [threading.Thread(target=hammer, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    hammered = {s.name for s in spans}
+    assert len([x for x in c.snapshot()
+                if x["name"] in hammered]) == len(spans)
+
+
+def test_abort_open_closes_spans_coherently():
+    c = trace.SpanCollector(capacity=32)
+    a = c.start("req-a")
+    a_child = c.start("req-a.child", parent=a)
+    b = c.start("req-b")
+    assert c.abort_open(trace_id=a.trace_id, reason="replica died") == 2
+    assert c.open_count == 1                      # b untouched
+    spans = c.spans_for(a.trace_id)
+    assert {s["name"] for s in spans} == {"req-a", "req-a.child"}
+    assert all(s["attrs"]["aborted"] == "replica died" for s in spans)
+    assert not export.validate_trace(spans)       # no orphans, all closed
+    c.end(b)
+    assert a_child.t1 is not None
+
+
+# --------------------------------------------------------- histogram --
+
+
+def test_histogram_percentiles_are_bucket_conservative():
+    h = Histogram(buckets=log_buckets(0.001, 64.0))
+    values = [0.002, 0.003, 0.01, 0.02, 0.05, 0.1, 0.5, 1.0, 2.0, 30.0]
+    for v in values:
+        h.observe(v)
+    assert h.count == len(values)
+    for q in (0.5, 0.95, 0.99):
+        true_p = sorted(values)[min(len(values) - 1,
+                                    int(q * len(values)))]
+        got = h.percentile(q)
+        assert got >= true_p                 # never understates
+        assert got <= true_p * 2             # within one factor-2 bucket
+    # overflow lands in +Inf and reports inf (NEVER the largest finite
+    # bound — that would understate, and an SLO threshold above the last
+    # bound could then never trip); the JSON snapshot clamps but makes
+    # the clamp visible via the overflow count
+    h.observe(10_000.0)
+    assert h.percentile(1.0) == float("inf")
+    snap = h.snapshot()
+    assert snap["overflow"] == 1
+    assert snap["p99"] == h.bounds[-1]           # finite for strict JSON
+
+
+def test_canary_gate_no_spurious_rollback_inside_a_bucket():
+    """Review regression: a threshold that is NOT a power-of-2 bucket
+    bound (1.0s sits inside the (0.512, 1.024] bucket) must not roll
+    back a canary whose true p95 is under it — the gate's histogram
+    carries the SLO threshold as an exact bound."""
+    from kubeflow_tpu.serving.controller import CanaryGate
+
+    gate = CanaryGate(max_error_rate=0.5, max_p95_latency_s=1.0,
+                      min_requests=5)
+    for _ in range(5):
+        gate.observe(True, 0.6)           # 40% under SLO
+    assert gate.p95_latency() <= 1.0
+    assert gate.decide() == "promote"
+    over = CanaryGate(max_error_rate=0.5, max_p95_latency_s=1.0,
+                      min_requests=5)
+    for _ in range(5):
+        over.observe(True, 1.01)          # just over: must trip
+    assert over.decide() == "rollback"
+
+
+def test_canary_gate_trips_slo_above_largest_bucket_bound():
+    """Review regression: a latency SLO threshold ABOVE the histogram's
+    largest finite bound (65.5s) must still be able to roll back — the
+    overflow percentile reports inf, not the last bound."""
+    from kubeflow_tpu.serving.controller import CanaryGate
+
+    gate = CanaryGate(max_error_rate=0.5, max_p95_latency_s=120.0,
+                      min_requests=5)
+    for _ in range(5):
+        gate.observe(True, 300.0)
+    assert gate.p95_latency() > 120.0
+    assert gate.decide() == "rollback"
+
+
+def test_histogram_merge_reset_and_snapshot_roundtrip():
+    a, b = Histogram(), Histogram()
+    for v in (0.01, 0.1):
+        a.observe(v)
+    b.observe(1.0)
+    a.merge(b)
+    assert a.count == 3
+    rt = Histogram.from_snapshot(a.snapshot())
+    assert rt.count == a.count
+    assert rt.percentile(0.5) == a.percentile(0.5)
+    assert abs(rt.sum - a.sum) < 1e-6
+    a.reset()
+    assert a.count == 0 and a.percentile(0.95) == 0.0
+
+
+def test_canary_gate_1m_observations_bounded_and_trips_slo():
+    """The ISSUE-14 regression: a gate fed 1M observations stays
+    O(buckets) memory (no raw latency list) and still trips the p95
+    SLO."""
+    from kubeflow_tpu.serving.controller import CanaryGate
+
+    gate = CanaryGate(max_error_rate=0.5, max_p95_latency_s=0.1,
+                      min_requests=10)
+    for i in range(1_000_000):
+        # 96% fast, 4% slow: p95 lands in the slow tail
+        gate.observe(True, 0.004 if i % 25 else 0.9)
+    assert not hasattr(gate, "_latencies")
+    # memory is the fixed bucket array, not the observation count
+    assert len(gate._latency_hist._counts) == \
+        len(gate._latency_hist.bounds) + 1
+    assert gate._latency_hist.count == 1_000_000
+    assert gate.p95_latency() <= 0.008            # p95 is in the fast mass
+    assert gate.decide() == "promote"
+    slow = CanaryGate(max_error_rate=0.5, max_p95_latency_s=0.1,
+                      min_requests=5)
+    for _ in range(5):
+        slow.observe(True, 1.0)
+    assert slow.p95_latency() > 0.1
+    assert slow.decide() == "rollback"
+
+
+# ------------------------------------------------- exposition lint --
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+def test_exposition_helper_enforces_naming():
+    with pytest.raises(ValueError):
+        expo.render_exposition([("kft_bad_counter", "counter",
+                                 [(None, 1.0)])])
+    with pytest.raises(ValueError):
+        expo.render_exposition([("kft_latency_ms", "histogram",
+                                 [(None, Histogram())])])
+    text = expo.render_exposition([
+        ("kft_ok_total", "counter", [(None, 1.0)]),
+        ("kft_lat_seconds", "histogram", [(None, Histogram())]),
+    ])
+    assert expo.validate_exposition(text) == []
+
+
+def test_validator_catches_malformed_expositions():
+    assert expo.validate_exposition("kft_orphan 1\n")   # no TYPE
+    bad_hist = (
+        "# HELP kft_x_seconds h\n# TYPE kft_x_seconds histogram\n"
+        'kft_x_seconds_bucket{le="1.0"} 5\n'
+        'kft_x_seconds_bucket{le="+Inf"} 4\n'           # not cumulative
+        "kft_x_seconds_sum 1\nkft_x_seconds_count 4\n")
+    assert any("cumulative" in p or "+Inf" in p
+               for p in expo.validate_exposition(bad_hist))
+
+
+def test_validator_accepts_any_label_order_around_le():
+    """Review regression: a producer emitting ``le`` FIRST (or labels
+    in any order) is still a valid histogram — series grouping must be
+    label-order-independent."""
+    text = (
+        "# HELP kft_x_seconds h\n# TYPE kft_x_seconds histogram\n"
+        'kft_x_seconds_bucket{le="1.0",model="m"} 2\n'
+        'kft_x_seconds_bucket{model="m",le="+Inf"} 3\n'
+        'kft_x_seconds_sum{model="m"} 1.5\n'
+        'kft_x_seconds_count{model="m"} 3\n')
+    assert expo.validate_exposition(text) == []
+
+
+def test_operator_metrics_exposition_lints_clean(tmp_path):
+    """The lint-style satellite, operator half: scrape the REAL operator
+    /metrics over HTTP and validate format + naming."""
+    from kubeflow_tpu.api.types import jax_job
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+
+    op = Operator(JobController(FakeCluster()),
+                  heartbeat_dir=str(tmp_path / "hb"))
+    port = op.start(port=0)
+    try:
+        op.submit(jax_job("lint-j", workers=1, mesh={"data": 1},
+                          command=["true"]))
+        op.metrics.observe("kft_reconcile_duration_seconds", 0.01)
+        text = _scrape(f"http://127.0.0.1:{port}/metrics")
+        assert expo.validate_exposition(text) == []
+        assert "# TYPE kft_jobs_submitted_total counter" in text
+    finally:
+        op.stop()
+
+
+class _StatsModel:
+    """Minimal model exposing the stats() families a real LLMModel
+    exports (sched counters + request histograms) without the engine."""
+
+    name = "stats-m"
+    ready = True
+
+    def __init__(self):
+        self.h = Histogram()
+        self.h.observe(0.01)
+
+    def metadata(self):
+        return {"name": self.name}
+
+    def stats(self):
+        return {
+            "generated_tokens_total": 5,
+            "depot_outcome": "hit",              # string: JSON-only
+            "sched": {"steps_total": 3, "queue_depth": 0},
+            "request_histograms": {"ttft": self.h.snapshot(),
+                                   "itl": self.h.snapshot(),
+                                   "e2e": self.h.snapshot()},
+        }
+
+
+def test_model_server_exposition_lints_clean_with_histograms():
+    """The lint satellite, model-server half: /metrics renders through
+    the same shared helper — counters typed by suffix, request
+    histograms as real Prometheus histograms, strings excluded."""
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.server import ModelServer
+
+    repo = ModelRepository()
+    repo.register(_StatsModel())
+    server = ModelServer(repo).start()
+    try:
+        text = _scrape(server.url + "/metrics")
+        assert expo.validate_exposition(text) == []
+        assert ("# TYPE kft_model_request_ttft_seconds histogram"
+                in text)
+        assert ("# TYPE kft_model_generated_tokens_total counter"
+                in text)
+        assert ("# TYPE kft_model_sched_queue_depth gauge" in text)
+        assert "depot_outcome" not in text       # strings never leak
+        assert 'kft_model_request_e2e_seconds_count{model="stats-m"} 1' \
+            in text
+    finally:
+        server.stop()
+
+
+# -------------------------------------- engine + propagation (e2e) --
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from kubeflow_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg)
+    return params, cfg
+
+
+def test_engine_trace_and_request_histograms(tiny):
+    from kubeflow_tpu.models import llama  # noqa: F401
+    from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+
+    params, cfg = tiny
+    col = trace.SpanCollector(capacity=256, proc="engine-test")
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(16,), obs=col)
+    parent = col.start("caller")
+    req = eng.add_request(list(range(1, 9)),
+                          SamplingParams(max_tokens=6),
+                          trace=parent.traceparent())
+    while eng.has_work():
+        eng.step()
+    col.end(parent)
+    assert req.done
+    spans = col.spans_for(parent.trace_id)
+    names = [s["name"] for s in spans]
+    assert "request.queue" in names
+    assert "prefill.batch" in names
+    assert names.count("decode.step") >= 1
+    assert not export.validate_trace(spans)
+    # queue span closed at admission with the slot attr
+    q = next(s for s in spans if s["name"] == "request.queue")
+    assert q["attrs"]["prompt_tokens"] == 8 and "slot" in q["attrs"]
+    # histograms: 1 request -> 1 ttft, 1 e2e, max_tokens-1 itl
+    assert eng.request_hists["ttft"].count == 1
+    assert eng.request_hists["e2e"].count == 1
+    assert eng.request_hists["itl"].count == 6 - 1
+    assert eng.request_hists["e2e"].percentile(0.95) >= \
+        eng.request_hists["ttft"].percentile(0.5)
+
+
+def test_engine_abort_closes_queue_span_no_histogram_pollution(tiny):
+    from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+
+    params, cfg = tiny
+    col = trace.SpanCollector(capacity=64, proc="abort-test")
+    eng = LLMEngine(params, cfg, max_batch=1, max_seq=64,
+                    prefill_buckets=(16,), obs=col)
+    # two waiting requests; only one slot — abort the queued one
+    r1 = eng.add_request([1, 2, 3], SamplingParams(max_tokens=4))
+    r2 = eng.add_request([4, 5, 6], SamplingParams(max_tokens=4))
+    eng.step()
+    eng.abort([r2])
+    while eng.has_work():
+        eng.step()
+    assert r1.done and r2.done and r2.aborted
+    q2 = next(s for s in col.spans_for(r2.trace[0])
+              if s["name"] == "request.queue")
+    assert q2["t1"] is not None and q2["attrs"].get("aborted") is True
+    # aborted request contributes no e2e observation
+    assert eng.request_hists["e2e"].count == 1
+    assert col.open_count == 0
+
+
+def test_router_repick_on_vanished_replica_keeps_trace_coherent(tiny):
+    """Satellite: a replica vanishing mid-route re-picks onto the
+    surviving fleet and the trace stays coherent (one closed router
+    span with the repick counted, no orphan parents)."""
+    from kubeflow_tpu.serving.protocol import InferRequest, InferTensor
+    from kubeflow_tpu.serving.router import FleetRouter
+
+    col = trace.SpanCollector(capacity=64, proc="router-test")
+    router = FleetRouter(block_size=4, obs=col)
+    served = []
+
+    def backend(request):
+        from kubeflow_tpu.serving.protocol import InferResponse
+        served.append(request.parameters.get("traceparent"))
+        return InferResponse(model_name="m", outputs=[], id=request.id)
+
+    router.add_replica("a", backend)
+    router.add_replica("b", backend)
+    prompt = [1, 2, 3, 4]
+    victim = router.pick(prompt)
+    survivor = "b" if victim == "a" else "a"
+    # the victim vanishes between pick and call: backend lookup fails,
+    # route() must re-pick onto the survivor instead of failing
+    orig_pick = router.pick
+    calls = []
+
+    def flaky_pick(p, request_id=None):
+        if not calls:
+            calls.append(1)
+            router.remove_replica(victim)
+            return victim
+        return orig_pick(p, request_id=request_id)
+
+    router.pick = flaky_pick
+    req = InferRequest(model_name="m", inputs=[
+        InferTensor.from_numpy("input-0",
+                               np.asarray(prompt, np.int32))])
+    resp = router.route(req, prompt)
+    assert resp is not None and served
+    span = next(s for s in col.snapshot()
+                if s["name"] == "router.route")
+    assert span["attrs"]["replica"] == survivor
+    assert span["attrs"]["repicked"] == 1
+    assert span["t1"] is not None
+    # the backend saw THIS span's context (propagation survived re-pick)
+    assert trace.parse_traceparent(served[0])[1] == span["span_id"]
+    assert not export.validate_trace(
+        col.spans_for(span["trace_id"]))
+
+
+def test_http_server_llm_full_trace_and_metrics(tiny):
+    """Tentpole e2e at unit scale: request through
+    FleetRouter -> ModelServer HTTP -> engine produces ONE trace
+    (router/server/queue/prefill/decode sharing a propagated id) and
+    live request histograms on /metrics."""
+    from kubeflow_tpu.serving.jax_model import LLMModel
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.protocol import InferRequest, InferTensor
+    from kubeflow_tpu.serving.router import FleetRouter
+    from kubeflow_tpu.serving.server import InferenceClient, ModelServer
+
+    params, cfg = tiny
+    model = LLMModel("m", params, cfg, max_batch=2, max_seq=64,
+                     prefill_buckets=(16,))
+    model.load()
+    repo = ModelRepository()
+    repo.register(model)
+    server = ModelServer(repo).start()
+    try:
+        router = FleetRouter(block_size=model.engine.paged.block_size)
+        router.add_replica("r0", InferenceClient(server.url))
+        prompt = list(range(1, 9))
+        req = InferRequest(model_name="m", inputs=[
+            InferTensor.from_numpy("input-0",
+                                   np.asarray(prompt, np.int32))],
+            parameters={"max_tokens": 4})
+        router.route(req, prompt)
+        snap = trace.collector().snapshot()
+        tid = next(s for s in reversed(snap)
+                   if s["name"] == "router.route")["trace_id"]
+        spans = export.spans_for(snap, tid)
+        names = {s["name"] for s in spans}
+        assert {"router.route", "server.infer",
+                "request.queue"} <= names
+        assert names & {"prefill.batch", "prefill.chunk"}
+        assert "decode.step" in names
+        assert not export.validate_trace(spans)
+        # server span parents under router; queue under server
+        by_name = {s["name"]: s for s in spans}
+        route_span = by_name["router.route"]
+        assert by_name["server.infer"]["parent_id"] == \
+            route_span["span_id"]
+        assert by_name["request.queue"]["parent_id"] == \
+            by_name["server.infer"]["span_id"]
+        text = _scrape(server.url + "/metrics")
+        assert expo.validate_exposition(text) == []
+        for fam in ("ttft", "itl", "e2e"):
+            assert f"kft_model_request_{fam}_seconds_bucket" in text
+        # chrome export loads and carries the spans
+        doc = export.chrome_trace(spans)
+        assert len([e for e in doc["traceEvents"]
+                    if e["ph"] == "X"]) == len(spans)
+        json.dumps(doc)                          # serializable
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- operator traces --
+
+
+def _phases(t0, **extra):
+    ph = {"proc_start": t0 + 0.10, "imports_done": t0 + 1.10,
+          "rendezvous_done": t0 + 1.30, "state_init_done": t0 + 1.50,
+          "restore_done": t0 + 1.80, "compile_done": t0 + 2.00,
+          "first_step_done": t0 + 2.10}
+    ph.update(extra)
+    return ph
+
+
+def test_build_job_trace_recovery_spans_match_phases():
+    t0 = time.time()
+    ph = _phases(t0, depot_hit=1.0, resumed_from_step=4.0)
+    events = [
+        {"t": t0, "event": "worker_failed", "pod": "j-worker-0",
+         "exit_code": -9},
+        {"t": t0 + 0.05, "event": "replacement", "pod": "j-worker-0",
+         "incarnation": 1, "epoch": 2},
+    ]
+    spans = export.build_job_trace(
+        "default", "j", "uid1", {"j-worker-0": ph},
+        recovery_events=events)
+    assert not export.validate_trace(spans)
+    by = {}
+    for s in spans:
+        by.setdefault(s["name"], []).append(s)
+    claim = by["recovery.claim"][0]
+    assert abs((claim["t1"] - claim["t0"]) - 0.10) < 1e-6
+    load = (by["recovery.load.imports"][0]["t1"]
+            - by["recovery.load.imports"][0]["t0"]
+            + by["recovery.load.acquire"][0]["t1"]
+            - by["recovery.load.acquire"][0]["t0"])
+    assert abs(load - (1.0 + 0.7)) < 1e-6
+    fsa = by["recovery.first_step_after"][0]
+    assert abs((fsa["t1"] - fsa["t0"]) - 0.10) < 1e-6
+    # non-timestamp stamps ride the worker root's attrs
+    root = by["worker:j-worker-0"][0]
+    assert root["attrs"]["depot_hit"] == 1.0
+    # everything shares the deterministic job trace id
+    assert len({s["trace_id"] for s in spans}) == 1
+
+
+def test_build_job_trace_replacement_dies_mid_claim_still_coherent():
+    """Satellite failure path: the FIRST replacement dies before ever
+    reporting phases; the second succeeds. The trace must stay coherent
+    — instant event spans for both failures, recovery phase spans only
+    for the surviving incarnation, no orphan parents."""
+    t0 = time.time()
+    events = [
+        {"t": t0, "event": "worker_failed", "pod": "j-worker-0"},
+        {"t": t0 + 0.05, "event": "replacement", "pod": "j-worker-0",
+         "incarnation": 1},
+        # replacement #1 dies mid-claim: failed again, no phases posted
+        {"t": t0 + 0.50, "event": "worker_failed", "pod": "j-worker-0"},
+        {"t": t0 + 0.55, "event": "replacement", "pod": "j-worker-0",
+         "incarnation": 2},
+    ]
+    # only the SECOND incarnation ever reported (proc_start after its
+    # detection time)
+    ph = _phases(t0 + 0.55)
+    spans = export.build_job_trace(
+        "default", "j", "uid1", {"j-worker-0": ph},
+        recovery_events=events)
+    assert not export.validate_trace(spans)
+    names = [s["name"] for s in spans]
+    assert names.count("recovery.worker_failed") == 2
+    assert names.count("recovery.replacement") == 2
+    # recovery PHASE spans exist ONLY for the surviving incarnation:
+    # replacement #1's window ended at the second failure, so the
+    # survivor's stamps must not duplicate a span set onto it (review
+    # regression — a doubled set would also double the bench's
+    # phase-agreement durations)
+    claims = [s for s in spans if s["name"] == "recovery.claim"]
+    assert len(claims) == 1
+    # and the surviving claim anchors at the SECOND detection
+    assert abs(claims[0]["t0"] - (t0 + 0.50)) < 1e-6
+    assert names.count("recovery.first_step_after") == 1
+
+
+def test_build_job_trace_worker_spans_only_not_dropped():
+    """Review regression: a job whose ONLY observations are explicitly
+    POSTed worker spans (no phase stamps, no recovery events yet) must
+    still export them — not silently return an empty trace."""
+    t0 = time.time()
+    spans = export.build_job_trace(
+        "default", "j", "uid1", {},
+        worker_spans={"j-worker-0": [
+            {"name": "w.io", "t0": t0, "t1": t0 + 0.25,
+             "attrs": {"bytes": 7}}]})
+    names = [s["name"] for s in spans]
+    assert "w.io" in names and "job:j" in names
+    assert not export.validate_trace(spans)
+
+
+def test_operator_trace_endpoint_token_fenced(tmp_path):
+    from kubeflow_tpu.api.types import jax_job
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+    from kubeflow_tpu.parallel.depot import DEPOT_TOKEN_HEADER
+
+    op = Operator(JobController(FakeCluster()),
+                  heartbeat_dir=str(tmp_path / "hb"))
+    port = op.start(port=0)
+    try:
+        job = jax_job("tr-j", workers=1, mesh={"data": 1},
+                      command=["true"])
+        op.submit(job)
+        t0 = time.time()
+        assert op.heartbeat_post(
+            "default", "tr-j", "tr-j-worker-0",
+            {"phases": _phases(t0, profile_dir="/tmp/prof"),
+             "spans": [{"name": "w.io", "t0": t0, "t1": t0 + 0.2,
+                        "attrs": {"bytes": 5}},
+                       {"bogus": True}, "junk"]},
+            uid=job.uid)
+        base = f"http://127.0.0.1:{port}/apis/v1/trace/default/tr-j"
+        # no token -> 403 (fenced like the depot routes)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base, timeout=5)
+        assert ei.value.code == 403
+        req = urllib.request.Request(
+            base, headers={DEPOT_TOKEN_HEADER: op.depot_token})
+        doc = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        names = {s["name"] for s in doc["spans"]}
+        assert {"worker.imports", "worker.rendezvous", "worker.compile",
+                "worker.first_step", "w.io"} <= names
+        assert not export.validate_trace(doc["spans"])
+        # profile artifact stamp surfaced as a span attr, not a span
+        root = next(s for s in doc["spans"]
+                    if s["name"] == "worker:tr-j-worker-0")
+        assert root["attrs"]["profile_dir"] == "/tmp/prof"
+        # chrome format loads as a trace-event document
+        req = urllib.request.Request(
+            base + "?format=chrome",
+            headers={DEPOT_TOKEN_HEADER: op.depot_token})
+        chrome = json.loads(
+            urllib.request.urlopen(req, timeout=5).read())
+        assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+        # unknown job 404s (with the token)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/apis/v1/trace/default/nope",
+            headers={DEPOT_TOKEN_HEADER: op.depot_token})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 404
+    finally:
+        op.stop()
+
+
+def test_trace_endpoint_reachable_on_depotless_operator():
+    """Review regression: an operator with NO depot (no heartbeat dir)
+    and no auth must still serve job traces — the depot-token fence
+    only applies when there is a depot token to hold."""
+    from kubeflow_tpu.api.types import jax_job
+    from kubeflow_tpu.controller import FakeCluster, JobController, Operator
+
+    op = Operator(JobController(FakeCluster()))
+    assert op.depot is None
+    port = op.start(port=0)
+    try:
+        job = jax_job("nd-j", workers=1, mesh={"data": 1},
+                      command=["true"])
+        op.submit(job)
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/apis/v1/trace/default/nd-j",
+            timeout=5).read())
+        assert doc == {"spans": []}      # no phase reports yet: empty
+    finally:
+        op.stop()
+
+
+# ------------------------------------------------ profiler wiring --
+
+
+def test_fit_profiles_from_env(tmp_path, monkeypatch, mesh_fsdp8):
+    """Satellite: KFT_PROFILE_DIR/KFT_PROFILE_STEPS reach
+    fit()'s jax.profiler toggle through the pod env — the trace
+    directory is created during the profiled window."""
+    import os
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.training import (
+        Trainer, TrainerConfig, lm_loss_fn, put_batch,
+        synthetic_lm_batches,
+    )
+    from kubeflow_tpu.training.loop import fit, profile_from_env
+
+    assert profile_from_env({}) == (None, None)
+    assert profile_from_env(
+        {"KFT_PROFILE_DIR": "/x", "KFT_PROFILE_STEPS": "1:3"}) \
+        == ("/x", (1, 3))
+    assert profile_from_env(
+        {"KFT_PROFILE_DIR": "/x", "KFT_PROFILE_STEPS": "junk"}) \
+        == ("/x", None)
+
+    prof = tmp_path / "prof"
+    monkeypatch.setenv("KFT_PROFILE_DIR", str(prof))
+    monkeypatch.setenv("KFT_PROFILE_STEPS", "1:2")
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    trainer = Trainer(
+        mesh=mesh_fsdp8,
+        init_params_fn=lambda r: llama.init_params(r, cfg),
+        params_logical_axes=llama.param_logical_axes(cfg),
+        loss_fn=lm_loss_fn(llama.forward, cfg),
+        config=TrainerConfig(learning_rate=1e-3, warmup_steps=1,
+                             total_steps=3),
+    )
+    batch = put_batch(mesh_fsdp8, next(iter(
+        synthetic_lm_batches(cfg.vocab_size, 8, 16))))
+    result = fit(trainer, iter([batch] * 3), rng=jax.random.key(0),
+                 max_steps=3)
+    produced = [os.path.join(dp, f)
+                for dp, _, fs in os.walk(prof) for f in fs]
+    assert produced, "profiled window produced no trace artifacts"
+    # the window's REAL start/stop wall times are reported (what
+    # worker_check stamps as profile_start/profile_done), and they
+    # bound the window, not the whole run
+    assert result.profile is not None
+    assert result.profile["dir"] == str(prof)
+    assert 0 <= (result.profile["t_stop"]
+                 - result.profile["t_start"]) < 60
+    # a run that never reaches the window reports NO profile (review
+    # regression: no phantom artifact stamp)
+    monkeypatch.setenv("KFT_PROFILE_STEPS", "50:60")
+    trainer.step = 0
+    r2 = fit(trainer, iter([batch] * 3), rng=jax.random.key(0),
+             max_steps=3)
+    assert r2.profile is None
