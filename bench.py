@@ -131,7 +131,13 @@ def main():
     submit_latency = _submit_to_first_step_bench()
     kube_latency = _kube_latency_bench()
     recovery = _recovery_bench()
-    proofs = _scale_proofs()
+    # MPMD pipeline (ISSUE 15): executed multi-process stages, measured
+    # bubble + DCN overlap; the measured overlap then replaces the
+    # roofline's assumed collective-overlap constant below
+    pipeline = _pipeline_bench()
+    measured_overlap = (pipeline.get("summary") or {}).get(
+        "dcn_overlap_fraction")
+    proofs = _scale_proofs(measured_overlap=measured_overlap)
     proj_8b = _project_8b_decode_v5p8(serve.get("roofline") or {})
 
     print(json.dumps({
@@ -168,6 +174,11 @@ def main():
             # load / rendezvous / first_step_after, with depot_outcome
             # and loss-curve continuity vs an uninterrupted run
             "recovery": recovery,
+            # MPMD pipeline parallelism (ROADMAP item 3): per-stage
+            # jitted programs as real processes, measured (not modeled)
+            # bubble fraction + DCN/compute overlap, loss-identical to
+            # the SPMD pipeline_apply oracle
+            "pipeline": pipeline,
             # VERDICT r5 Missing #2: the serving north-star config
             # (Llama-3-8B on v5p-8/TP=4) projected analytically from the
             # decode roofline, calibrated by this run's measured v5e gap
@@ -2003,16 +2014,284 @@ def _recovery_bench() -> dict:
         cleanup()
 
 
-def _scale_proofs() -> list:
+def _scale_proofs(measured_overlap=None) -> list:
     """AOT per-chip HBM proofs for the BASELINE configs this chip can't
     run (8B serving on v5p-8; 70B FSDP on 2-slice v5p-128); ~3 min of
-    XLA:TPU compile time, no device memory touched."""
+    XLA:TPU compile time, no device memory touched. ``measured_overlap``
+    (the MPMD pipeline bench's dcn_overlap_fraction) replaces the
+    roofline's assumed collective-overlap constant — est_basis flips
+    from "assumed" to "measured"."""
     try:
         from kubeflow_tpu.parallel.aot import scale_proofs
 
-        return [p.to_dict() for p in scale_proofs()]
+        return [p.to_dict() for p in scale_proofs(
+            measured_overlap=measured_overlap,
+            overlap_src="MPMD pipeline bench dcn_overlap_fraction")]
     except Exception as e:                     # never sink the bench line
         return [{"error": f"{type(e).__name__}: {e}"}]
+
+
+# ----------------------------------------------------- MPMD pipeline --
+
+# the measured-pipeline model (parallel/mpmd.py harness): sized so one
+# tick is ~15-20ms of real matmul on a CPU bench box — large enough that
+# wire latency is a few % of a tick (the analytic fill-drain bound
+# models schedule idleness only), small enough that four legs fit CI
+_PIPE_DIMS = dict(stages=2, batch=256, dim=512, layers=8, steps=8)
+_PIPE_M = 4            # GPipe microbatches (activation stash = M)
+_PIPE_M_1F1B = 8       # 1F1B at the SAME stash budget (<= S) runs 2M
+
+
+def _mpmd_leg(op, ctl, cluster, name: str, env_base: dict, schedule: str,
+              microbatches: int, report_root: str) -> dict:
+    """Submit ONE MPMD pipeline job (S real worker processes, TCP
+    transport, gang-scheduled as one JAXJob) and fold its stage reports
+    into measured bubble/overlap + losses + per-stage depot outcomes."""
+    import os
+    import shutil
+
+    from kubeflow_tpu.api.types import pipeline_jax_job
+    from kubeflow_tpu.parallel.mpmd import (
+        PipelineRunConfig, aggregate_stats,
+    )
+
+    report = os.path.join(report_root, name)
+    shutil.rmtree(report, ignore_errors=True)
+    os.makedirs(report, exist_ok=True)
+    env = {**env_base,
+           "KFT_MPMD_SCHEDULE": schedule,
+           "KFT_MPMD_MICROBATCHES": str(microbatches),
+           "KFT_MPMD_REPORT_DIR": report}
+    op.submit(pipeline_jax_job(
+        name, stages=_PIPE_DIMS["stages"],
+        command=[sys.executable, "-m", "kubeflow_tpu.parallel.mpmd"],
+        env=env))
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        job = ctl.get("default", name)
+        if job is not None and job.status.is_finished():
+            break
+        time.sleep(0.2)
+    job = ctl.get("default", name)
+    if job is None or not job.status.is_finished():
+        return {"error": f"job {name} did not finish in 300s"}
+    if job.status.condition().value != "Succeeded":
+        logs = "\n".join(
+            cluster.pod_log("default", p.name)[-1500:]
+            for p in cluster.list_pods("default", {"job-name": name}) or []
+            if p is not None)
+        return {"error": f"job {name} failed", "logs": logs[-4000:]}
+    cfg = PipelineRunConfig(
+        n_stages=_PIPE_DIMS["stages"], microbatches=microbatches,
+        global_batch=_PIPE_DIMS["batch"], dim=_PIPE_DIMS["dim"],
+        layers_per_stage=_PIPE_DIMS["layers"], steps=_PIPE_DIMS["steps"],
+        schedule=schedule)
+    reports = []
+    for s in range(cfg.n_stages):
+        with open(os.path.join(report, f"stage-{s}.json")) as f:
+            reports.append(json.load(f))
+    agg = aggregate_stats(reports, cfg)
+    depot = {str(r["stage"]): r["depot"] for r in reports}
+    return {"measured": agg,
+            "losses": reports[-1]["losses"],
+            "depot": depot,
+            "depot_outcome": ("hit" if all(
+                d["hit"] for d in depot.values()) else "miss")}
+
+
+def _pipeline_bench() -> dict:
+    """ISSUE-15 acceptance: the MPMD pipeline EXECUTED multi-process on
+    the operator rig — per-stage jitted programs as real OS processes,
+    DCN-style TCP transport, gang-scheduled as ONE JAXJob whose workers
+    carry the stage rendezvous env, per-stage executables through the
+    depot.
+
+    Four legs:
+    - ``gpipe``  (M=4, blocking transport): the fill-drain parity
+      baseline — measured bubble must AGREE with (S-1)/(S+M-1);
+      publishes every stage's fwd/bwd/head executable to the depot.
+    - ``one_f1b`` (M=4, async transport): warm RESUBMIT of the same
+      programs — per-stage depot hits, losses bitwise-equal to gpipe
+      (schedule cannot change math), dcn overlap -> ~1.
+    - ``one_f1b_2m`` (M=8): 1F1B at GPipe's activation budget (stash
+      <= S even at 2M) — the schedule's real win: measured bubble must
+      BEAT the GPipe bound and the GPipe measurement.
+    - ``oracle``: the single-program SPMD pipeline_apply run (2 virtual
+      devices, one subprocess) — the loss-trajectory reference.
+    """
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    from kubeflow_tpu.controller import (
+        JobController, LocalProcessCluster, Operator,
+    )
+    from kubeflow_tpu.parallel.mpmd import analytic_bubble_bound
+
+    tmp = tempfile.mkdtemp(prefix="kft-bench-pipe-")
+    cluster = LocalProcessCluster(log_dir=os.path.join(tmp, "pods"))
+    ctl = JobController(cluster)
+    op = Operator(ctl, heartbeat_dir=os.path.join(tmp, "hb"),
+                  reconcile_period=0.1, heartbeat_period=0.2)
+    op.start(port=0)
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env_base = {
+            "PYTHONPATH": repo + ":" + os.environ.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "KFT_FORCE_PLATFORM": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "KFT_COMPILE_CACHE": os.path.join(tmp, "xla-cache"),
+            "KFT_MPMD_BATCH": str(_PIPE_DIMS["batch"]),
+            "KFT_MPMD_DIM": str(_PIPE_DIMS["dim"]),
+            "KFT_MPMD_LAYERS": str(_PIPE_DIMS["layers"]),
+            "KFT_MPMD_STEPS": str(_PIPE_DIMS["steps"]),
+        }
+        out: dict = {"topology": dict(_PIPE_DIMS),
+                     "backend": "LocalProcessCluster/cpu "
+                                "(one process per stage, TCP transport)"}
+        out["gpipe"] = _mpmd_leg(op, ctl, cluster, "pipe-gpipe", env_base,
+                                 "gpipe", _PIPE_M, tmp)
+        out["one_f1b"] = _mpmd_leg(op, ctl, cluster, "pipe-1f1b", env_base,
+                                   "1f1b", _PIPE_M, tmp)
+        out["one_f1b_2m"] = _mpmd_leg(op, ctl, cluster, "pipe-1f1b-2m",
+                                      env_base, "1f1b", _PIPE_M_1F1B, tmp)
+
+        # the SPMD single-program oracle (2 virtual CPU devices)
+        oracle_env = {**os.environ, **env_base,
+                      "KFT_NUM_STAGES": str(_PIPE_DIMS["stages"]),
+                      "KFT_MPMD_SCHEDULE": "1f1b",
+                      "KFT_MPMD_MICROBATCHES": str(_PIPE_M),
+                      "KFT_MPMD_REPORT_DIR": os.path.join(tmp, "oracle"),
+                      "XLA_FLAGS": "--xla_force_host_platform_device_"
+                                   f"count={_PIPE_DIMS['stages']}"}
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.parallel.mpmd",
+             "--oracle"], env=oracle_env, capture_output=True, timeout=300)
+        if proc.returncode != 0:
+            out["oracle"] = {"error": proc.stdout.decode()[-2000:]
+                             + proc.stderr.decode()[-2000:]}
+        else:
+            with open(os.path.join(tmp, "oracle", "oracle.json")) as f:
+                out["oracle"] = json.load(f)
+
+        # ---- parity: MPMD vs schedule-twin and vs the SPMD oracle ----
+        lg = (out["gpipe"] or {}).get("losses") or []
+        lf = (out["one_f1b"] or {}).get("losses") or []
+        lo = (out.get("oracle") or {}).get("losses") or []
+        parity: dict = {"schedules_bitwise_identical":
+                        bool(lg) and lg == lf}
+        if lf and lo and len(lf) == len(lo):
+            rel = [abs(a - b) / max(abs(b), 1e-12) for a, b in zip(lf, lo)]
+            parity.update({
+                "oracle_step0_bitwise": lf[0] == lo[0],
+                "oracle_max_rel_diff": max(rel),
+                "oracle_exact": ("bitwise through step "
+                                 f"{sum(1 for a, b in zip(lf, lo) if a == b)}"
+                                 f"/{len(lo)}; XLA fusion round-off beyond"),
+            })
+        out["parity"] = parity
+
+        # ---- the measured claims -------------------------------------
+        g = (out["gpipe"] or {}).get("measured") or {}
+        f2 = (out["one_f1b_2m"] or {}).get("measured") or {}
+        f1 = (out["one_f1b"] or {}).get("measured") or {}
+        bound = analytic_bubble_bound(_PIPE_DIMS["stages"], _PIPE_M)
+        summary = {
+            "gpipe_bubble_measured": g.get("bubble_fraction"),
+            "gpipe_bubble_analytic": round(bound, 4),
+            "gpipe_vs_analytic": (
+                round(g["bubble_fraction"] / bound, 3)
+                if g.get("bubble_fraction") is not None else None),
+            "one_f1b_2m_bubble_measured": f2.get("bubble_fraction"),
+            "one_f1b_2m_bubble_analytic": f2.get(
+                "analytic_fill_drain_bound"),
+            "dcn_overlap_fraction": f1.get("dcn_overlap_fraction"),
+            "dcn_overlap_fraction_gpipe": g.get("dcn_overlap_fraction"),
+            "est_basis": "measured (multi-process MPMD run; supersedes "
+                         "the modeled collective-overlap assumption for "
+                         "this rig's roofline)",
+        }
+        out["summary"] = summary
+
+        # ---- per-stage spans reached the operator job trace ----------
+        trace_deadline = time.time() + 10
+        names: set = set()
+        while time.time() < trace_deadline:
+            spans = op.job_trace("default", "pipe-1f1b")
+            names = {s.get("name") for s in spans}
+            if "pipeline.tick" in names and "dcn.transfer" in names:
+                break
+            time.sleep(0.5)
+        out["trace"] = {
+            "span_names": sorted(n for n in names if n),
+            "has_pipeline_ticks": "pipeline.tick" in names,
+            "has_dcn_transfers": "dcn.transfer" in names,
+        }
+        return out
+    except Exception as e:                     # never sink the bench line
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        for name in ("pipe-gpipe", "pipe-1f1b", "pipe-1f1b-2m"):
+            try:
+                ctl.delete("default", name)
+            except KeyError:
+                pass
+        op.stop()
+        cluster.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def pipeline_smoke_main():
+    """``bench.py --pipeline-smoke``: ONLY the MPMD pipeline bench (CPU,
+    CI-runnable, ~1-2 min) as one JSON line — the `make test-pipeline`
+    acceptance entry point. Exits nonzero unless a real multi-process
+    >=2-stage 1F1B run completed with its loss trajectory matching the
+    SPMD pipeline_apply oracle (bitwise vs the GPipe twin, step-0
+    bitwise + fusion-level round-off vs the oracle), measured GPipe
+    bubble within 15% of the analytic (S-1)/(S+M-1) fill-drain bound,
+    1F1B (memory-matched 2M) bubble STRICTLY below both, a reported
+    dcn_overlap_fraction, per-stage depot hits on the warm-resubmit
+    leg, and pipeline.tick/dcn.transfer spans in the operator job
+    trace."""
+    out = _pipeline_bench()
+    s = out.get("summary") or {}
+    print(json.dumps({
+        "metric": "pipeline_bubble_fraction_1f1b_2m",
+        "value": s.get("one_f1b_2m_bubble_measured"),
+        "unit": "fraction",
+        "extra": out,
+    }))
+    parity = out.get("parity") or {}
+    trace = out.get("trace") or {}
+    g_meas = s.get("gpipe_bubble_measured")
+    g_bound = s.get("gpipe_bubble_analytic")
+    f2_meas = s.get("one_f1b_2m_bubble_measured")
+    ok = ("error" not in out
+          and all("error" not in (out.get(k) or {"error": 1})
+                  for k in ("gpipe", "one_f1b", "one_f1b_2m", "oracle"))
+          # loss trajectory: schedule-invariant AND oracle-faithful
+          and parity.get("schedules_bitwise_identical") is True
+          and parity.get("oracle_step0_bitwise") is True
+          and parity.get("oracle_max_rel_diff") is not None
+          and parity["oracle_max_rel_diff"] <= 2e-5
+          # measured GPipe bubble agrees with the fill-drain bound
+          and g_meas is not None
+          and abs(g_meas - g_bound) / g_bound <= 0.15
+          # 1F1B at GPipe's activation budget beats bound AND measurement
+          and f2_meas is not None
+          and f2_meas < g_meas and f2_meas < g_bound
+          # overlap measured and reported
+          and s.get("dcn_overlap_fraction") is not None
+          and s["dcn_overlap_fraction"]
+              > (s.get("dcn_overlap_fraction_gpipe") or 0.0)
+          # warm resubmit deserialized EVERY stage's executables
+          and (out.get("one_f1b") or {}).get("depot_outcome") == "hit"
+          # per-stage spans landed in the operator job trace
+          and trace.get("has_pipeline_ticks") is True
+          and trace.get("has_dcn_transfers") is True)
+    return 0 if ok else 1
 
 
 def serving_smoke_main():
@@ -2354,6 +2633,13 @@ if __name__ == "__main__":
                          "a served request produced a >=6-span trace, "
                          "the Perfetto export loads, and all three "
                          "request histograms have nonzero counts)")
+    ap.add_argument("--pipeline-smoke", action="store_true",
+                    help="only the MPMD pipeline bench (CI smoke; "
+                         "nonzero exit unless a real multi-process "
+                         "2-stage 1F1B run matched the SPMD oracle, "
+                         "measured GPipe bubble agreed with the "
+                         "fill-drain bound, 1F1B beat it, and per-stage "
+                         "depot hits happened on the warm leg)")
     ap.add_argument("--recovery-smoke", action="store_true",
                     help="only the elastic-recovery scenario on the kube "
                          "rig (CI smoke; nonzero exit unless a real "
@@ -2370,6 +2656,8 @@ if __name__ == "__main__":
         sys.exit(fleet_smoke_main())
     if cli.obs_smoke:
         sys.exit(obs_smoke_main())
+    if cli.pipeline_smoke:
+        sys.exit(pipeline_smoke_main())
     if cli.recovery_smoke:
         sys.exit(recovery_smoke_main())
     sys.exit(kube_main() if cli.cluster == "kube" else main())

@@ -237,6 +237,37 @@ def jax_job(
     )
 
 
+def pipeline_jax_job(
+    name: str,
+    *,
+    stages: int,
+    workers_per_stage: int = 1,
+    tpu: TPUSpec | None = None,
+    image: str = "kubeflow-tpu/runtime:latest",
+    command: list[str] | None = None,
+    env: dict[str, str] | None = None,
+    run_policy: RunPolicy | None = None,
+    namespace: str = "default",
+) -> JobSpec:
+    """Build an MPMD pipeline JAXJob: ``stages`` per-stage worker groups
+    gang-scheduled as ONE job (one PodGroup, all-or-nothing admission —
+    a pipeline with a missing stage can never make progress, so partial
+    placement is wasted capacity). The controller stamps each worker's
+    stage rendezvous env (KFT_STAGE_ID / _BIND / _PREV / _NEXT, backed
+    by one stable Service per stage) next to the usual JAXJob contract;
+    ``rendezvous.bootstrap.stage_from_env`` reads it in-worker. A dead
+    stage worker takes the per-worker replacement path (PR 9) — the
+    stage Services keep the neighbor addresses valid across it."""
+    if stages < 2:
+        raise ValidationError("pipeline_jax_job needs stages >= 2")
+    env = dict(env or {})
+    env["KFT_NUM_STAGES"] = str(stages)
+    return jax_job(
+        name, workers=stages * workers_per_stage, tpu=tpu, image=image,
+        command=command, env=env, run_policy=run_policy,
+        namespace=namespace)
+
+
 def tf_job(
     name: str,
     *,
@@ -340,6 +371,20 @@ def validate(job: JobSpec) -> None:
                     f"{rtype}: topology {t.tpu.topology} not divisible by "
                     f"chips_per_host={t.tpu.chips_per_host}"
                 )
+        stages_env = _worker_env(job).get("KFT_NUM_STAGES")
+        if stages_env:
+            try:
+                n_stages = int(stages_env)
+            except ValueError:
+                raise ValidationError(
+                    f"KFT_NUM_STAGES must be an int, got {stages_env!r}")
+            w = job.replica_specs[ReplicaType.WORKER.value].replicas
+            if n_stages < 2:
+                raise ValidationError("MPMD pipeline needs >= 2 stages")
+            if w % n_stages:
+                raise ValidationError(
+                    f"workers={w} not divisible by pipeline stages="
+                    f"{n_stages} (stage groups must be equal)")
         mesh_env = _worker_env(job).get("KFT_MESH")
         if mesh_env:
             from kubeflow_tpu.parallel.mesh import AXIS_ORDER

@@ -66,6 +66,27 @@ def _job_selector(job: JobSpec) -> dict[str, str]:
     return {"job-name": job.name, "job-uid": job.uid}
 
 
+def _pipeline_stages(job: JobSpec) -> int:
+    """MPMD pipeline topology marker: a JAXJob whose Worker template
+    carries KFT_NUM_STAGES is a pipeline gang — its workers split into
+    per-stage groups, gang-scheduled as ONE job (one PodGroup, one
+    all-or-nothing admission), each group its own jitted program wired
+    to its neighbors by the stage rendezvous env below. 0 = not MPMD."""
+    if job.kind != "JAXJob":
+        return 0
+    spec = job.replica_specs.get(ReplicaType.WORKER.value)
+    if spec is None:
+        return 0
+    try:
+        return int(spec.template.env.get("KFT_NUM_STAGES", "0"))
+    except ValueError:
+        return 0
+
+
+def _stage_service_name(job: JobSpec, stage: int) -> str:
+    return f"{job.name}-stage-{stage}"
+
+
 class JobController:
     """Reconciles JobSpecs against a Cluster. Also plays the apiserver role:
     `submit`/`get`/`delete` mutate the job store, `reconcile` converges it."""
@@ -158,6 +179,9 @@ class JobController:
         if job:
             self._delete_pods(job)
             self.cluster.delete_service(namespace, job.name)
+            for sid in range(_pipeline_stages(job)):
+                self.cluster.delete_service(
+                    namespace, _stage_service_name(job, sid))
             self.scheduler.remove_group(namespace, job.name)
             self._requeue_at.pop((namespace, name), None)
             self._replacing.pop((namespace, name), None)
@@ -222,6 +246,20 @@ class JobController:
                 name=job.name, namespace=job.namespace,
                 selector=_job_selector(job), port=COORDINATOR_PORT,
             ))
+        # MPMD pipeline jobs: one service PER STAGE, so the stage
+        # rendezvous env (KFT_STAGE_BIND / _PREV / _NEXT) resolves to an
+        # address that is stable across per-worker replacement — a
+        # replaced stage worker binds the same resolved endpoint and its
+        # neighbors' env keeps pointing at it, no re-stamp needed
+        for sid in range(_pipeline_stages(job)):
+            sname = _stage_service_name(job, sid)
+            if self.cluster.get_service(job.namespace, sname) is None:
+                self.cluster.create_service(Service(
+                    name=sname, namespace=job.namespace,
+                    selector={**_job_selector(job),
+                              "pipeline-stage": str(sid)},
+                    port=COORDINATOR_PORT + 1 + sid,
+                ))
 
     def _ensure_podgroup(self, job: JobSpec) -> None:
         sched = job.run_policy.scheduling
@@ -257,10 +295,15 @@ class JobController:
                         env["KFT_RENDEZVOUS_EPOCH"] = str(
                             job.status.rendezvous_epoch)
                     tpu = spec.template.tpu
+                    labels = {**_job_selector(job), "replica-type": rtype,
+                              "replica-index": str(i)}
+                    if "KFT_STAGE_ID" in env:
+                        # stage selector for the per-stage service (and
+                        # anything else that addresses one stage's group)
+                        labels["pipeline-stage"] = env["KFT_STAGE_ID"]
                     pod = Pod(
                         name=name, namespace=job.namespace,
-                        labels={**_job_selector(job), "replica-type": rtype,
-                                "replica-index": str(i)},
+                        labels=labels,
                         env=env,
                         command=list(spec.template.command),
                         image=spec.template.image,
@@ -340,6 +383,29 @@ class JobController:
                 "TPU_WORKER_ID": str(index),
             }
             spec = job.replica_specs[rtype]
+            stages = _pipeline_stages(job)
+            if stages > 1 and rtype == ReplicaType.WORKER.value:
+                # MPMD stage rendezvous (parallel/mpmd.py): workers split
+                # into contiguous per-stage groups; each learns its stage,
+                # its own stable listen address, and its neighbors' — the
+                # point-to-point activation/grad links. Stage workers do
+                # NOT form one jax.distributed world (each stage is its
+                # own program on its own mesh), so KFT_NUM_PROCESSES etc.
+                # above stay purely informational for them.
+                wps = max(1, spec.replicas // stages)
+                sid = min(index // wps, stages - 1)
+                env["KFT_NUM_STAGES"] = str(stages)
+                env["KFT_STAGE_ID"] = str(sid)
+                env["KFT_STAGE_WORKERS"] = str(wps)
+                env["KFT_STAGE_PROC_ID"] = str(index % wps)
+                env["KFT_STAGE_BIND"] = self.cluster.resolve(
+                    job.namespace, _stage_service_name(job, sid))
+                if sid > 0:
+                    env["KFT_STAGE_PREV"] = self.cluster.resolve(
+                        job.namespace, _stage_service_name(job, sid - 1))
+                if sid < stages - 1:
+                    env["KFT_STAGE_NEXT"] = self.cluster.resolve(
+                        job.namespace, _stage_service_name(job, sid + 1))
             if spec.template.tpu is not None:
                 tpu = spec.template.tpu
                 env["KFT_TPU_ACCELERATOR"] = tpu.accelerator

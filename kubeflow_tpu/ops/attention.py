@@ -112,10 +112,15 @@ def _ambient_mesh():
     """The mesh in context at trace time: `with mesh:` populates the
     thread-resource env (what with_sharding_constraint resolves against);
     newer `jax.sharding.use_mesh` populates the abstract mesh instead —
-    accept either."""
-    abstract = jax.sharding.get_abstract_mesh()
-    if abstract is not None and abstract.axis_names:
-        return abstract
+    accept either. Version-tolerant: ``jax.sharding.get_abstract_mesh``
+    only exists on newer jax (0.5+); older eras (0.4.x) have no abstract
+    mesh at all, so the thread-resource fallback below is the whole
+    story there."""
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        abstract = get_abstract()
+        if abstract is not None and abstract.axis_names:
+            return abstract
     try:
         from jax._src.mesh import thread_resources
 
@@ -155,10 +160,13 @@ def _shard_mapped(kernel, q, k, v):
             kernel, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)
     except (TypeError, AttributeError):   # older jax: no check_vma / no jax.shard_map
-        from kubeflow_tpu.parallel.ring_attention import shard_map
+        # jax 0.4.x spells the same escape hatch check_rep=False (pallas
+        # has no replication rule on that era either)
+        from jax.experimental.shard_map import shard_map as _old_shard_map
 
-        wrapped = shard_map(kernel, mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec)
+        wrapped = _old_shard_map(kernel, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_rep=False)
     return wrapped(q, k, v)
 
 

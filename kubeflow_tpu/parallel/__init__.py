@@ -25,6 +25,16 @@ from kubeflow_tpu.parallel.pipeline import (
     pipeline_loss_fn,
     stack_stage_params,
 )
+from kubeflow_tpu.parallel.mpmd import (
+    PipelineRunConfig,
+    StageRuntime,
+    aggregate_stats,
+    analytic_bubble_bound,
+    run_inproc,
+    run_oracle,
+    run_stage,
+    schedule_ticks,
+)
 from kubeflow_tpu.parallel.pipeline_llama import (
     init_pipeline_params,
     pipeline_forward,

@@ -116,14 +116,27 @@ def toolchain_versions() -> dict:
 
 
 def fingerprint(hlo_text: str, mesh=None, platform: str = "",
-                extra: tuple = ()) -> str:
+                extra: tuple = (), stage: Optional[int] = None) -> str:
     """Content-address a compiled program: sha256 over the lowered HLO,
     the mesh/topology it was built for, and the toolchain that built it.
     Everything that changes the machine code must be in here — two
     workers computing the same key MUST be able to share the executable.
+
+    ``stage`` scopes the key to one MPMD pipeline stage (parallel/mpmd.py):
+    pipeline stages routinely lower to IDENTICAL HLO (same stage_fn, same
+    shapes — only the param VALUES differ), but each stage's executable is
+    owned by its own worker group on its own per-stage mesh, and a warm
+    resubmit must hit the entry for ITS stage. The stage index is hashed
+    with a distinguishing prefix so same-HLO different-stage keys can
+    never collide; ``mesh`` should then be the STAGE mesh, folding the
+    stage-mesh fingerprint (axes, device kinds, size) into the same key.
+    The same scoping later serves disaggregated prefill/decode pools
+    (prefill and decode programs keyed per pool role).
     """
     h = hashlib.sha256()
     h.update(hlo_text.encode())
+    if stage is not None:
+        h.update(f"pipeline_stage={int(stage)}".encode())
     if mesh is not None:
         h.update(json.dumps(sorted(dict(mesh.shape).items())).encode())
         kinds = sorted({getattr(d, "device_kind", "?")
@@ -347,6 +360,7 @@ def _fetch(depot, key: str,
 
 
 def load_or_compile(lowered, depot=None, *, mesh=None, extra: tuple = (),
+                    stage: Optional[int] = None,
                     stats: Optional[DepotStats] = None,
                     wait_s: float = 0.0, poll_s: float = 0.5):
     """The one entry point: fingerprint ``lowered``, fetch the executable
@@ -359,11 +373,15 @@ def load_or_compile(lowered, depot=None, *, mesh=None, extra: tuple = (),
     the coordinator's publish instead of racing it with an Nth identical
     compile; a tombstone entry (publisher couldn't serialize) or the
     timeout ends the wait and compiles locally, counted.
+
+    ``stage`` scopes the key to an MPMD pipeline stage (identical HLO
+    across stages must never share an entry — see ``fingerprint``);
+    ``mesh`` is then the stage's own mesh.
     """
     stats = stats if stats is not None else DepotStats()
     if depot is None:
         return lowered.compile(), "no_depot"
-    key = fingerprint(lowered.as_text(), mesh=mesh, extra=extra)
+    key = fingerprint(lowered.as_text(), mesh=mesh, extra=extra, stage=stage)
 
     deadline = time.monotonic() + max(0.0, wait_s)
     waited = False

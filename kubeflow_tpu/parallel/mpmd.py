@@ -1,0 +1,1176 @@
+"""Multi-slice MPMD pipeline parallelism over DCN — executed, not modeled.
+
+The SPMD pipeline (``parallel/pipeline.py``) keeps every stage in ONE
+jitted program on one mesh: correct, and the single-program ORACLE this
+module is tested against, but it cannot span slices — a v5p-128 job is
+several ICI islands joined by DCN, and XLA will not place one SPMD
+program across them. The MPMD design here follows "Scaling Deep Learning
+Training with MPMD Pipeline Parallelism" (PAPERS.md): each stage is its
+OWN jitted program on its OWN per-stage mesh (slice), activations and
+grad-activations move stage-to-stage over an explicit point-to-point
+transport, and a schedule (fill-drain GPipe baseline, 1F1B default)
+drives the per-stage tick order.
+
+Transport: host-staged send/recv (``jax.device_get`` -> wire ->
+``jax.device_put``), which is ``jax.transfer_guard``-safe by construction
+— every host transfer is explicit. On the CPU/emulated rig the wire is
+loopback TCP (plus an optional per-transfer emulated DCN delay so
+overlap is measurable); on real slices the same framing rides the DCN
+between slice hosts. Two send disciplines are first-class because the
+difference IS the measurement: ``blocking`` (GPipe parity baseline —
+transfer time sits on the critical path, matching the analytic roofline's
+un-overlapped collective model) and ``async`` (1F1B — a sender thread
+drains a queue, so the wire hides under the next tick's compute).
+
+Measured, not projected (the ISSUE-15 contract):
+- ``bubble_fraction``: 1 - busy/(S * step window), aggregated over the
+  post-warmup steps from per-stage busy accounting. GPipe must agree
+  with the analytic fill-drain bound (S-1)/(S+M-1); 1F1B at the same
+  activation stash (<= S live microbatches per stage, so it can run
+  2M microbatches in GPipe's M-sized memory) must beat it.
+- ``dcn_overlap_fraction``: 1 - send_block_s/wire_s — the fraction of
+  wire time hidden under compute. ~0 for the blocking baseline, ->1 for
+  the async 1F1B engine.
+
+Numerics contract (tested): GPipe and 1F1B runs are BITWISE identical
+(same per-microbatch programs, grads stashed per slot and reduced in one
+fixed descending order — the same order the oracle's scan-VJP uses), and
+both match the SPMD ``pipeline_apply`` oracle to float32 round-off
+(step-0 loss bitwise; the trajectories drift only by XLA fusion-level
+ulps, gated tightly — see tests/test_mpmd.py).
+
+Per-stage executables are compile-once across the gang: fwd/bwd/head
+programs go through ``parallel/depot.load_or_compile`` keyed with the
+NEW ``stage=`` scope + the stage-mesh fingerprint, so a warm resubmit
+deserializes every stage's programs instead of recompiling — and two
+stages whose programs lower to IDENTICAL HLO (the common case: same
+stage_fn, same shapes) can never collide on one entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from kubeflow_tpu.parallel.depot import DepotStats, load_or_compile
+
+# ----------------------------------------------------------- config --
+
+
+@dataclasses.dataclass
+class PipelineRunConfig:
+    """One MPMD pipeline training run (the harness model is a stacked
+    tanh-MLP per stage + a linear regression head on the last stage —
+    big enough to give stable per-tick compute on a CPU bench box, small
+    enough for CI; ``stage_fn`` has the same contract as
+    ``pipeline_apply``'s, so the schedule/transport layer is generic)."""
+
+    n_stages: int = 2
+    microbatches: int = 4
+    global_batch: int = 64
+    dim: int = 128
+    layers_per_stage: int = 2
+    steps: int = 4
+    lr: float = 0.05
+    seed: int = 0
+    schedule: str = "1f1b"            # "gpipe" | "1f1b"
+    dcn_delay_ms: float = 0.0         # emulated per-transfer DCN latency
+
+    @property
+    def mb_rows(self) -> int:
+        return self.global_batch // self.microbatches
+
+    def validate(self) -> None:
+        if self.n_stages < 2:
+            raise ValueError("MPMD pipeline needs >= 2 stages")
+        if self.global_batch % self.microbatches:
+            raise ValueError("global_batch must divide by microbatches")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
+
+    @classmethod
+    def from_env(cls, env=None) -> "PipelineRunConfig":
+        env = os.environ if env is None else env
+        g = lambda k, d: env.get(f"KFT_MPMD_{k}", d)
+        return cls(
+            n_stages=int(env.get("KFT_NUM_STAGES", "2")),
+            microbatches=int(g("MICROBATCHES", "4")),
+            global_batch=int(g("BATCH", "64")),
+            dim=int(g("DIM", "128")),
+            layers_per_stage=int(g("LAYERS", "2")),
+            steps=int(g("STEPS", "4")),
+            lr=float(g("LR", "0.05")),
+            seed=int(g("SEED", "0")),
+            schedule=g("SCHEDULE", "1f1b"),
+            dcn_delay_ms=float(g("DCN_DELAY_MS", "0")),
+        )
+
+
+# ------------------------------------------------------- harness model --
+
+def mlp_stage_fn(stage_params, x):
+    """One pipeline stage: a scan over ``layers_per_stage`` tanh-MLP
+    layers. Same (params, x) -> y contract as pipeline_apply's stage_fn;
+    x and y share a shape (the inter-stage activation contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    def layer(h, lp):
+        return jnp.tanh(h @ lp["w"] + lp["b"]), None
+
+    y, _ = jax.lax.scan(layer, x, stage_params)
+    return y
+
+
+def init_stage_params(cfg: PipelineRunConfig, stage: int):
+    """Deterministic per-stage params: every process (stage workers, the
+    SPMD oracle) derives the same values from (seed, stage)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.fold_in(jax.random.key(cfg.seed), stage)
+    kw, _ = jax.random.split(k)
+    L, D = cfg.layers_per_stage, cfg.dim
+    w = jax.random.normal(kw, (L, D, D), jnp.float32) * (0.5 / np.sqrt(D))
+    return {"w": w, "b": jnp.zeros((L, D), jnp.float32)}
+
+
+def init_head_params(cfg: PipelineRunConfig):
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.fold_in(jax.random.key(cfg.seed), cfg.n_stages + 17)
+    return {"w": jax.random.normal(k, (cfg.dim, 1), jnp.float32)
+            * (1.0 / np.sqrt(cfg.dim))}
+
+
+def step_batch(cfg: PipelineRunConfig, step: int):
+    """(x [B, D], targets [B, 1]) for one step — derived from (seed,
+    step) so stage 0 (inputs) and the last stage (targets) agree without
+    any data channel between them."""
+    import jax
+
+    k = jax.random.fold_in(jax.random.key(cfg.seed + 100003), step)
+    kx, kt = jax.random.split(k)
+    x = jax.random.normal(kx, (cfg.global_batch, cfg.dim), np.float32)
+    t = jax.random.normal(kt, (cfg.global_batch, 1), np.float32)
+    return x, t
+
+
+def head_loss(head_params, y, targets, *, microbatches: int):
+    """Per-MICROBATCH loss term: mean squared error over the microbatch,
+    pre-scaled by 1/M so the per-step total (sum over microbatches)
+    equals the full-batch mean-of-means — decomposable per microbatch,
+    which is what lets 1F1B start backward before later forwards exist."""
+    import jax.numpy as jnp
+
+    return jnp.mean((y @ head_params["w"] - targets) ** 2) / microbatches
+
+
+# ------------------------------------------------------------ schedule --
+
+def schedule_ticks(schedule: str, n_stages: int, stage: int,
+                   microbatches: int) -> list[tuple[str, int]]:
+    """The per-stage tick order. GPipe: fill-drain (all forwards, then
+    all backwards — activation stash grows to M). 1F1B: (S-1-s) warmup
+    forwards, then strict one-forward-one-backward, then drain — the
+    stash never exceeds S live microbatches, which is the memory
+    headroom that lets 1F1B run more microbatches than GPipe at the
+    same budget (the schedule's real advantage; see aggregate_stats)."""
+    M = microbatches
+    if schedule == "gpipe":
+        return ([("fwd", i) for i in range(M)]
+                + [("bwd", i) for i in reversed(range(M))])
+    warm = min(n_stages - 1 - stage, M)
+    ticks: list[tuple[str, int]] = [("fwd", i) for i in range(warm)]
+    done = 0
+    for i in range(warm, M):
+        ticks.append(("fwd", i))
+        ticks.append(("bwd", done))
+        done += 1
+    ticks.extend(("bwd", i) for i in range(done, M))
+    return ticks
+
+
+def max_live_stash(ticks: list[tuple[str, int]]) -> int:
+    """Peak number of forward activations resident between their fwd and
+    bwd ticks — the schedule's activation-memory footprint."""
+    live, peak = 0, 0
+    for phase, _ in ticks:
+        live += 1 if phase == "fwd" else -1
+        peak = max(peak, live)
+    return peak
+
+
+# ----------------------------------------------------------- transport --
+
+class TransportStats:
+    """Per-stage wire accounting (thread-safe): ``wire_s`` is time spent
+    actually moving bytes (serialize + emulated DCN delay + socket write),
+    wherever it ran; ``send_block_s`` is the part that blocked the
+    COMPUTE thread — the exposed, un-overlapped cost. recv_block_s is
+    time the compute thread waited for data not yet arrived (schedule
+    fill/drain shows up here, not in send accounting)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.wire_s = 0.0
+        self.send_block_s = 0.0
+        self.recv_block_s = 0.0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.sends = 0
+        self.recvs = 0
+
+    def add(self, **kw) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "wire_s": round(self.wire_s, 6),
+                "send_block_s": round(self.send_block_s, 6),
+                "recv_block_s": round(self.recv_block_s, 6),
+                "bytes_sent": self.bytes_sent,
+                "bytes_recv": self.bytes_recv,
+                "sends": self.sends, "recvs": self.recvs,
+            }
+
+
+class _Mailbox:
+    """Keyed rendezvous for incoming frames: readers block per key.
+
+    ``poison`` fails every current and future ``take`` immediately with
+    the given cause — how a background sender thread's transport error
+    reaches the compute thread promptly instead of surfacing two
+    minutes later as an opaque recv timeout."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._box: dict[tuple, Any] = {}
+        self._poison: Optional[BaseException] = None
+
+    def put(self, key: tuple, value) -> None:
+        with self._lock:
+            self._box[key] = value
+            self._lock.notify_all()
+
+    def poison(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._poison is None:
+                self._poison = exc
+            self._lock.notify_all()
+
+    def take(self, key: tuple, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while key not in self._box:
+                if self._poison is not None:
+                    raise RuntimeError(
+                        "stage transport failed") from self._poison
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"no message {key!r} in {timeout_s}s")
+                self._lock.wait(left)
+            return self._box.pop(key)
+
+
+def _encode(key: tuple, payload) -> bytes:
+    body = pickle.dumps((key, payload), protocol=4)
+    return struct.pack(">Q", len(body)) + body
+
+
+class TCPStageChannel:
+    """Point-to-point activation/grad transport for ONE stage process.
+
+    Listens on ``bind``; neighbors connect lazily (with retry — gang
+    members come up in any order). ``blocking=True`` sends inline on the
+    compute thread (the GPipe baseline: wire time is exposed);
+    ``blocking=False`` hands frames to a per-peer sender thread (1F1B:
+    wire time overlaps the next tick's compute). ``delay_s`` emulates a
+    DCN per-transfer latency on loopback — it sleeps in whichever thread
+    carries the wire, so blocking/async expose/hide it exactly like real
+    link time. Spans: every wire movement records a ``dcn.transfer``
+    span into ``collector`` when one is given."""
+
+    def __init__(self, bind: str, *, prev: Optional[str], next: Optional[str],
+                 stage: int, blocking: bool = True, delay_s: float = 0.0,
+                 collector=None, timeout_s: float = 120.0):
+        self.stage = stage
+        self.prev_addr = prev
+        self.next_addr = next
+        self.blocking = blocking
+        self.delay_s = delay_s
+        self.timeout_s = timeout_s
+        self.collector = collector
+        self.stats = TransportStats()
+        self.mailbox = _Mailbox()
+        self._conns: dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._senders: dict[str, queue.Queue] = {}
+        self._sender_threads: list[threading.Thread] = []
+        self._closed = threading.Event()
+        host, _, port = bind.rpartition(":")
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._srv.bind((host or "127.0.0.1", int(port)))
+        except OSError:
+            # kube contract: KFT_STAGE_BIND is the stage SERVICE address
+            # (a DNS name routing to this pod) — a pod cannot bind() the
+            # service VIP, it binds the PORT on all interfaces and the
+            # Service routes to it. Loopback rigs never take this path
+            # (resolve() hands back a locally bindable 127.0.0.1:port).
+            self._srv.bind(("0.0.0.0", int(port)))
+        self._srv.listen(8)
+        bound_host = self._srv.getsockname()[0]
+        self.address = (f"{host or '127.0.0.1'}"
+                        f":{self._srv.getsockname()[1]}"
+                        if bound_host == "0.0.0.0"
+                        else f"{bound_host}:{self._srv.getsockname()[1]}")
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"mpmd-accept-{stage}").start()
+
+    # --------------------------------------------------------- wire in --
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             daemon=True,
+                             name=f"mpmd-read-{self.stage}").start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                head = self._read_exact(conn, 8)
+                if head is None:
+                    return
+                (n,) = struct.unpack(">Q", head)
+                body = self._read_exact(conn, n)
+                if body is None:
+                    return
+                key, payload = pickle.loads(body)
+                self.stats.add(bytes_recv=8 + n, recvs=1)
+                self.mailbox.put(key, payload)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return
+
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -------------------------------------------------------- wire out --
+
+    def _connect(self, peer: str) -> socket.socket:
+        with self._conn_lock:
+            s = self._conns.get(peer)
+            if s is not None:
+                return s
+        host, _, port = peer.rpartition(":")
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)), timeout=5.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"stage {self.stage}: peer {peer} unreachable "
+                        f"after {self.timeout_s}s")
+                time.sleep(0.05)
+        with self._conn_lock:
+            self._conns.setdefault(peer, s)
+            return self._conns[peer]
+
+    def _wire_send(self, peer: str, key: tuple, payload) -> None:
+        """The actual wire movement — serialize, emulated DCN latency,
+        socket write. Runs on the compute thread (blocking) or a sender
+        thread (async); ``wire_s`` counts it either way."""
+        t0 = time.perf_counter()
+        span = None
+        if self.collector is not None:
+            span = self.collector.start(
+                "dcn.transfer", attrs={"stage": self.stage, "peer": peer,
+                                       "kind": key[0], "step": key[1],
+                                       "mb": key[2]})
+        data = _encode(key, payload)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self._connect(peer).sendall(data)
+        dt = time.perf_counter() - t0
+        self.stats.add(wire_s=dt, bytes_sent=len(data), sends=1)
+        if span is not None:
+            self.collector.end(span, bytes=len(data))
+
+    def _sender_loop(self, peer: str, q: "queue.Queue") -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            try:
+                self._wire_send(peer, *item)
+            except Exception as e:
+                if self._closed.is_set():
+                    return
+                # surface the transport failure to the compute thread NOW
+                # (its next recv raises with this cause) instead of dying
+                # silently and leaving it to a 2-minute recv timeout
+                self.mailbox.poison(e)
+                return
+
+    def _send(self, peer: str, key: tuple, payload) -> None:
+        if self.blocking:
+            t0 = time.perf_counter()
+            self._wire_send(peer, key, payload)
+            self.stats.add(send_block_s=time.perf_counter() - t0)
+            return
+        q = self._senders.get(peer)
+        if q is None:
+            q = self._senders[peer] = queue.Queue()
+            t = threading.Thread(target=self._sender_loop, args=(peer, q),
+                                 daemon=True,
+                                 name=f"mpmd-send-{self.stage}")
+            t.start()
+            self._sender_threads.append(t)
+        t0 = time.perf_counter()
+        q.put((key, payload))
+        self.stats.add(send_block_s=time.perf_counter() - t0)  # ~enqueue
+
+    # ------------------------------------------------------------- api --
+
+    def send_act(self, step: int, mb: int, payload) -> None:
+        self._send(self.next_addr, ("act", step, mb), payload)
+
+    def send_grad(self, step: int, mb: int, payload) -> None:
+        self._send(self.prev_addr, ("grad", step, mb), payload)
+
+    def recv_act(self, step: int, mb: int):
+        return self._recv(("act", step, mb))
+
+    def recv_grad(self, step: int, mb: int):
+        return self._recv(("grad", step, mb))
+
+    def _recv(self, key: tuple):
+        t0 = time.perf_counter()
+        try:
+            return self.mailbox.take(key, self.timeout_s)
+        finally:
+            self.stats.add(recv_block_s=time.perf_counter() - t0)
+
+    def barrier_ready(self) -> None:
+        """Chain barrier: 'ready' propagates last-stage -> stage 0, then
+        'go' propagates stage 0 -> last. Every stage returns only once
+        the WHOLE pipeline is compiled and listening, so step-0 sends
+        never queue into a neighbor's compile window and the measured
+        windows start aligned."""
+        if self.next_addr:
+            self.mailbox.take(("ready", -1, -1), self.timeout_s)
+        if self.prev_addr:
+            self._wire_send(self.prev_addr, ("ready", -1, -1), b"")
+            self.mailbox.take(("go", -1, -1), self.timeout_s)
+        if self.next_addr:
+            self._wire_send(self.next_addr, ("go", -1, -1), b"")
+
+    def close(self) -> None:
+        self._closed.set()
+        for q in self._senders.values():
+            q.put(None)
+        for t in self._sender_threads:
+            t.join(timeout=5.0)
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class InProcFabric:
+    """In-process stand-in for the TCP fabric (unit tests, the dryrun):
+    one mailbox per stage, threads as stages. Same channel API, same
+    stats/delay semantics, no sockets."""
+
+    def __init__(self, n_stages: int):
+        self.mailboxes = [_Mailbox() for _ in range(n_stages)]
+
+    def channel(self, stage: int, *, blocking: bool = True,
+                delay_s: float = 0.0, collector=None,
+                timeout_s: float = 60.0) -> "InProcChannel":
+        return InProcChannel(self, stage, blocking=blocking,
+                             delay_s=delay_s, collector=collector,
+                             timeout_s=timeout_s)
+
+
+class InProcChannel:
+    def __init__(self, fabric: InProcFabric, stage: int, *, blocking: bool,
+                 delay_s: float, collector, timeout_s: float):
+        self.fabric = fabric
+        self.stage = stage
+        self.blocking = blocking
+        self.delay_s = delay_s
+        self.collector = collector
+        self.timeout_s = timeout_s
+        self.stats = TransportStats()
+        self._q: Optional[queue.Queue] = None
+        self._sender: Optional[threading.Thread] = None
+
+    def _wire_send(self, dest: int, key: tuple, payload) -> None:
+        t0 = time.perf_counter()
+        span = None
+        if self.collector is not None:
+            span = self.collector.start(
+                "dcn.transfer", attrs={"stage": self.stage, "peer": dest,
+                                       "kind": key[0], "step": key[1],
+                                       "mb": key[2]})
+        data = _encode(key, payload)       # pay real serialize cost
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        k, p = pickle.loads(data[8:])
+        self.fabric.mailboxes[dest].put(k, p)
+        dt = time.perf_counter() - t0
+        self.stats.add(wire_s=dt, bytes_sent=len(data), sends=1)
+        if span is not None:
+            self.collector.end(span, bytes=len(data))
+
+    def _send(self, dest: int, key: tuple, payload) -> None:
+        if self.blocking:
+            t0 = time.perf_counter()
+            self._wire_send(dest, key, payload)
+            self.stats.add(send_block_s=time.perf_counter() - t0)
+            return
+        if self._q is None:
+            self._q = queue.Queue()
+
+            def loop():
+                while True:
+                    item = self._q.get()
+                    if item is None:
+                        return
+                    self._wire_send(*item)
+
+            self._sender = threading.Thread(
+                target=loop, daemon=True, name=f"mpmd-send-{self.stage}")
+            self._sender.start()
+        t0 = time.perf_counter()
+        self._q.put((dest, key, payload))
+        self.stats.add(send_block_s=time.perf_counter() - t0)
+
+    def send_act(self, step, mb, payload):
+        self._send(self.stage + 1, ("act", step, mb), payload)
+
+    def send_grad(self, step, mb, payload):
+        self._send(self.stage - 1, ("grad", step, mb), payload)
+
+    def recv_act(self, step, mb):
+        return self._recv(("act", step, mb))
+
+    def recv_grad(self, step, mb):
+        return self._recv(("grad", step, mb))
+
+    def _recv(self, key):
+        t0 = time.perf_counter()
+        try:
+            return self.fabric.mailboxes[self.stage].take(key, self.timeout_s)
+        finally:
+            self.stats.add(recv_block_s=time.perf_counter() - t0)
+
+    def barrier_ready(self) -> None:
+        pass                                   # threads start together
+
+    def close(self) -> None:
+        if self._q is not None:
+            self._q.put(None)
+            self._sender.join(timeout=5.0)
+
+
+# -------------------------------------------------------- stage runtime --
+
+class StageRuntime:
+    """One stage's compiled programs + parameters on its own mesh.
+
+    Programs are AOT-compiled up front (fwd, bwd = VJP of stage_fn, and
+    on the last stage the loss-head VJP) through the executable depot
+    when one is given — keyed per STAGE + stage mesh, so a warm resubmit
+    deserializes instead of compiling and two same-HLO stages never
+    share an entry. Gradients stash per microbatch slot and reduce in
+    one fixed descending-index order (matching the scan-VJP accumulation
+    order of the SPMD oracle), so the result is schedule-independent —
+    GPipe and 1F1B produce bitwise-identical updates."""
+
+    def __init__(self, cfg: PipelineRunConfig, stage: int, *,
+                 stage_fn: Callable = mlp_stage_fn, mesh=None,
+                 depot=None, depot_stats: Optional[DepotStats] = None,
+                 depot_wait_s: float = 0.0):
+        import jax
+        import jax.numpy as jnp
+
+        cfg.validate()
+        self.cfg = cfg
+        self.stage = stage
+        self.is_first = stage == 0
+        self.is_last = stage == cfg.n_stages - 1
+        self.mesh = mesh
+        self.depot_stats = depot_stats if depot_stats is not None \
+            else DepotStats()
+        self.depot_outcomes: dict[str, str] = {}
+        self.params = init_stage_params(cfg, stage)
+        self.head_params = init_head_params(cfg) if self.is_last else None
+        self._last_losses: list = []
+
+        M = cfg.microbatches
+        R = cfg.mb_rows
+
+        def bwd_fn(p, x, dy):
+            _, pull = jax.vjp(stage_fn, p, x)
+            return pull(dy)
+
+        def head_fn(hp, y, t):
+            (loss, (gh, dy)) = jax.value_and_grad(
+                lambda hp_, y_, t_: head_loss(hp_, y_, t_, microbatches=M),
+                argnums=(0, 1))(hp, y, t)
+            return loss, gh, dy
+
+        def sgd(p, g):
+            return jax.tree_util.tree_map(
+                lambda a, b: a - cfg.lr * b, p, g)
+
+        self._add = jax.jit(
+            lambda a, b: jax.tree_util.tree_map(jnp.add, a, b))
+
+        def reduce_slots(slots):
+            # descending-index sequential sum — the scan-VJP order the
+            # SPMD oracle accumulates its per-tick param grads in — via
+            # the ONE pre-warmed jitted tree-add (same per-leaf add op
+            # bitwise, no per-step eager dispatch or re-trace)
+            acc = slots[-1]
+            for g in slots[-2::-1]:
+                acc = self._add(acc, g)
+            return acc
+
+        x_eg = jnp.zeros((R, cfg.dim), jnp.float32)
+        t_eg = jnp.zeros((R, 1), jnp.float32)
+        if mesh is not None:
+            # per-stage mesh: microbatch rows sharded over the stage's
+            # data axis, params replicated within the stage. The jitted
+            # programs auto-partition against these placements.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._x_sharding = NamedSharding(mesh, P("stage_dp"))
+            self._rep = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, self._rep)
+            if self.head_params is not None:
+                self.head_params = jax.device_put(self.head_params,
+                                                  self._rep)
+            x_eg = jax.device_put(x_eg, self._x_sharding)
+            t_eg = jax.device_put(t_eg, self._x_sharding)
+        else:
+            self._x_sharding = None
+
+        def _compile(name, fn, *eg):
+            lowered = jax.jit(fn).lower(*eg)
+            compiled, outcome = load_or_compile(
+                lowered, depot, mesh=mesh, stage=stage,
+                extra=("mpmd", name), stats=self.depot_stats,
+                wait_s=depot_wait_s)
+            self.depot_outcomes[name] = outcome
+            return compiled
+
+        self._fwd = _compile("fwd", stage_fn, self.params, x_eg)
+        dy_eg = x_eg
+        self._bwd = _compile("bwd", bwd_fn, self.params, x_eg, dy_eg)
+        if self.is_last:
+            self._head = _compile("head", head_fn, self.head_params,
+                                  x_eg, t_eg)
+        # tiny programs: warmed eagerly so no compile lands inside the
+        # measured window, but not worth depot entries
+        g_eg = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self._sgd = jax.jit(sgd)
+        self._reduce = reduce_slots
+        jax.block_until_ready(self._sgd(self.params, g_eg))
+        jax.block_until_ready(self._add(g_eg, g_eg))
+
+    # ------------------------------------------------------- execution --
+
+    def put_act(self, arr: np.ndarray):
+        """Host-staged wire payload -> this stage's mesh (explicit
+        device_put: transfer_guard-safe)."""
+        import jax
+
+        if self._x_sharding is not None:
+            return jax.device_put(arr, self._x_sharding)
+        return jax.device_put(arr)
+
+    @staticmethod
+    def get_act(y) -> np.ndarray:
+        import jax
+
+        return np.asarray(jax.device_get(y))
+
+    def fwd(self, x):
+        import jax
+
+        return jax.block_until_ready(self._fwd(self.params, x))
+
+    def bwd(self, x, dy):
+        import jax
+
+        g, dx = self._bwd(self.params, x, dy)
+        jax.block_until_ready(dx)
+        return g, dx
+
+    def head(self, y, t):
+        import jax
+
+        loss, gh, dy = self._head(self.head_params, y, t)
+        jax.block_until_ready(dy)
+        return loss, gh, dy
+
+    def apply_grads(self, grad_slots: list, head_slots: Optional[list]):
+        import jax
+
+        self.params = self._sgd(self.params, self._reduce(grad_slots))
+        if head_slots is not None:
+            self.head_params = self._sgd(self.head_params,
+                                         self._reduce(head_slots))
+            jax.block_until_ready(self.head_params)
+        jax.block_until_ready(self.params)
+
+    def depot_summary(self) -> dict:
+        return {"outcomes": dict(self.depot_outcomes),
+                "hit": all(v == "hit" for v in self.depot_outcomes.values()),
+                "counters": self.depot_stats.snapshot()}
+
+
+# ------------------------------------------------------------ run loop --
+
+@dataclasses.dataclass
+class StageResult:
+    stage: int
+    losses: list          # last stage only; [] elsewhere
+    step_stats: list      # per step: {"t0","t1","busy_s"}
+    transport: dict
+    depot: dict
+    schedule: str
+    max_stash: int
+
+
+def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
+              runtime: Optional[StageRuntime] = None, collector=None,
+              on_step: Optional[Callable[[int, Optional[float]], None]] = None,
+              ) -> StageResult:
+    """Execute ``cfg.steps`` pipeline training steps for ONE stage.
+
+    The tick order comes from ``schedule_ticks``; data dependencies
+    (recv act / recv grad) provide all cross-stage synchronization. Per
+    tick, compute time is accounted to ``busy_s`` and a ``pipeline.tick``
+    span is recorded; the channel accounts wire/blocked time and records
+    ``dcn.transfer`` spans. Stage 0's per-step [t0, t1] window brackets
+    the whole pipeline (it injects first and its update depends on the
+    last returning grad), so aggregate_stats measures every stage's idle
+    against stage 0's windows.
+
+    Busy accounting matches what the analytic fill-drain bound models:
+    everything the stage actively DOES — compute, host staging
+    (device_put/get), and the blocking part of sends — is work; bubble
+    is the remaining (schedule-induced) idleness. An exposed transfer
+    still raises the measured bubble, just where it physically bites:
+    as the DOWNSTREAM stage's wait (and in send_block/overlap stats)."""
+    import jax  # noqa: F401  (device staging inside runtime)
+
+    rt = runtime if runtime is not None else StageRuntime(cfg, stage)
+    ticks = schedule_ticks(cfg.schedule, cfg.n_stages, stage,
+                           cfg.microbatches)
+    M, R = cfg.microbatches, cfg.mb_rows
+    chan.barrier_ready()
+    step_stats = []
+    losses: list = []
+    for k in range(cfg.steps):
+        if rt.is_first:
+            x_full, _ = step_batch(cfg, k)
+            x_host = np.asarray(x_full).reshape(M, R, cfg.dim)
+        if rt.is_last:
+            _, t_full = step_batch(cfg, k)
+            t_host = np.asarray(t_full).reshape(M, R, 1)
+        # perf_counter, not wall clock: windows and busy must share a
+        # clock domain (aggregate_stats only ever compares DURATIONS —
+        # stage 0's window vs each stage's busy — so process-local
+        # monotonic time is both sufficient and NTP-proof)
+        t_step0 = time.perf_counter()
+        busy = 0.0
+        block0 = chan.stats.snapshot()["send_block_s"]
+        stash: dict[int, tuple] = {}
+        grad_slots: list = [None] * M
+        head_slots: Optional[list] = [None] * M if rt.is_last else None
+        step_losses: list = [None] * M
+        for phase, i in ticks:
+            span = None
+            if collector is not None:
+                span = collector.start("pipeline.tick", attrs={
+                    "stage": stage, "step": k, "mb": i, "phase": phase})
+            if phase == "fwd":
+                if rt.is_first:
+                    c0 = time.perf_counter()
+                    x = rt.put_act(x_host[i])
+                    busy += time.perf_counter() - c0
+                else:
+                    arr = chan.recv_act(k, i)
+                    c0 = time.perf_counter()
+                    x = rt.put_act(arr)
+                    busy += time.perf_counter() - c0
+                c0 = time.perf_counter()
+                y = rt.fwd(x)
+                busy += time.perf_counter() - c0
+                stash[i] = (x, y)
+                if not rt.is_last:
+                    c0 = time.perf_counter()
+                    chan.send_act(k, i, rt.get_act(y))
+                    busy += time.perf_counter() - c0
+            else:
+                x, y = stash.pop(i)
+                if rt.is_last:
+                    c0 = time.perf_counter()
+                    t = rt.put_act(t_host[i])
+                    loss_i, gh, dy = rt.head(y, t)
+                    g, dx = rt.bwd(x, dy)
+                    busy += time.perf_counter() - c0
+                    head_slots[i] = gh
+                    step_losses[i] = loss_i
+                else:
+                    dy_arr = chan.recv_grad(k, i)
+                    c0 = time.perf_counter()
+                    dy = rt.put_act(dy_arr)
+                    g, dx = rt.bwd(x, dy)
+                    busy += time.perf_counter() - c0
+                grad_slots[i] = g
+                if not rt.is_first:
+                    c0 = time.perf_counter()
+                    chan.send_grad(k, i, rt.get_act(dx))
+                    busy += time.perf_counter() - c0
+            if span is not None:
+                collector.end(span)
+        c0 = time.perf_counter()
+        rt.apply_grads(grad_slots, head_slots)
+        if rt.is_last:
+            total = step_losses[0]
+            for li in step_losses[1:]:
+                total = total + li
+            losses.append(float(total))
+        busy += time.perf_counter() - c0
+        # the blocking part of sends is already inside the timed regions
+        # above (send_* called under the busy clock); nothing to add —
+        # but record the per-step exposure for the overlap stats
+        block1 = chan.stats.snapshot()["send_block_s"]
+        step_stats.append({"t0": t_step0, "t1": time.perf_counter(),
+                           "busy_s": round(busy, 6),
+                           "send_block_s": round(block1 - block0, 6)})
+        if on_step is not None:
+            on_step(k, losses[-1] if rt.is_last else None)
+    return StageResult(
+        stage=stage, losses=losses, step_stats=step_stats,
+        transport=chan.stats.snapshot(), depot=rt.depot_summary(),
+        schedule=cfg.schedule, max_stash=max_live_stash(ticks))
+
+
+# --------------------------------------------------------- measurement --
+
+def analytic_bubble_bound(n_stages: int, microbatches: int) -> float:
+    """The fill-drain bound: stage s idles s ticks at fill and S-1-s at
+    drain, per phase — (S-1)/(S+M-1) of the schedule, independent of the
+    fwd/bwd time ratio (both phases scale together)."""
+    return (n_stages - 1) / (n_stages + microbatches - 1)
+
+
+def aggregate_stats(results: list, cfg: PipelineRunConfig,
+                    skip_steps: int = 1) -> dict:
+    """Fold per-stage StageResults (or their dict form) into the measured
+    pipeline numbers. Bubble is idle-vs-window against stage 0's step
+    windows (stage 0 brackets every step — see run_stage); the first
+    ``skip_steps`` steps are excluded (first-call cache warming). DCN
+    overlap is 1 - send_block/wire: the wire time hidden under compute."""
+    def _d(r):
+        return r if isinstance(r, dict) else dataclasses.asdict(r)
+
+    rs = sorted((_d(r) for r in results), key=lambda r: r["stage"])
+    S = cfg.n_stages
+    if len(rs) != S:
+        raise ValueError(f"need all {S} stage reports, got {len(rs)}")
+    windows = rs[0]["step_stats"]
+    n_steps = min(len(r["step_stats"]) for r in rs)
+    per_step = []
+    for k in range(skip_steps, n_steps):
+        w = windows[k]["t1"] - windows[k]["t0"]
+        if w <= 0:
+            continue
+        idle = sum(max(0.0, w - r["step_stats"][k]["busy_s"]) for r in rs)
+        per_step.append(idle / (S * w))
+    bubble = sum(per_step) / len(per_step) if per_step else None
+    wire = sum(r["transport"]["wire_s"] for r in rs)
+    blocked = sum(r["transport"]["send_block_s"] for r in rs)
+    overlap = (1.0 - min(blocked, wire) / wire) if wire > 0 else None
+    busy = [sum(st["busy_s"] for st in r["step_stats"][skip_steps:n_steps])
+            for r in rs]
+    ticks = 2 * cfg.microbatches * max(1, n_steps - skip_steps)
+    return {
+        "schedule": cfg.schedule,
+        "n_stages": S,
+        "microbatches": cfg.microbatches,
+        "steps_measured": max(0, n_steps - skip_steps),
+        "bubble_fraction": round(bubble, 4) if bubble is not None else None,
+        "bubble_fraction_per_step": [round(b, 4) for b in per_step],
+        "analytic_fill_drain_bound": round(
+            analytic_bubble_bound(S, cfg.microbatches), 4),
+        "dcn_overlap_fraction": (round(overlap, 4)
+                                 if overlap is not None else None),
+        "dcn_wire_s": round(wire, 4),
+        "dcn_send_block_s": round(blocked, 4),
+        "mean_tick_s": round(sum(busy) / (S * ticks), 6) if ticks else None,
+        "max_activation_stash": max(r["max_stash"] for r in rs),
+        "per_stage_busy_s": [round(b, 4) for b in busy],
+        "est_basis": "measured (per-stage busy vs stage-0 step windows; "
+                     "overlap = 1 - send_block/wire)",
+    }
+
+
+def run_inproc(cfg: PipelineRunConfig, *, collector=None,
+               runtimes: Optional[list] = None) -> tuple[list, list[float]]:
+    """All stages as threads over the in-process fabric (tests/dryrun).
+    Returns (per-stage StageResults, last-stage losses)."""
+    fabric = InProcFabric(cfg.n_stages)
+    results: list = [None] * cfg.n_stages
+    errors: list = []
+
+    def work(s: int):
+        chan = fabric.channel(
+            s, blocking=cfg.schedule == "gpipe",
+            delay_s=cfg.dcn_delay_ms / 1e3, collector=collector)
+        try:
+            results[s] = run_stage(
+                cfg, s, chan,
+                runtime=runtimes[s] if runtimes else None,
+                collector=collector)
+        except Exception as e:                     # surfaced by the join
+            errors.append((s, e))
+        finally:
+            chan.close()
+
+    threads = [threading.Thread(target=work, args=(s,), daemon=True)
+               for s in range(cfg.n_stages)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    if errors:
+        raise RuntimeError(f"stage failures: {errors!r}") from errors[0][1]
+    if any(r is None for r in results):
+        raise TimeoutError("a stage thread did not finish")
+    return results, results[-1].losses
+
+
+# -------------------------------------------------------------- oracle --
+
+def run_oracle(cfg: PipelineRunConfig,
+               stage_fn: Callable = mlp_stage_fn) -> list[float]:
+    """The single-program SPMD oracle: the SAME model/microbatching/loss
+    through ``pipeline_apply`` on a pipeline mesh (needs >= n_stages
+    local devices), same SGD updates. The MPMD runs must reproduce this
+    loss trajectory (step 0 bitwise; later steps to fusion-level ulps)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from kubeflow_tpu.parallel.pipeline import (
+        pipeline_apply, stack_stage_params,
+    )
+
+    cfg.validate()
+    devs = jax.devices()
+    if len(devs) < cfg.n_stages:
+        raise RuntimeError(
+            f"oracle needs {cfg.n_stages} devices, have {len(devs)} "
+            "(set --xla_force_host_platform_device_count)")
+    mesh = Mesh(np.array(devs[:cfg.n_stages]), ("pipeline",))
+    fwd = pipeline_apply(stage_fn, mesh, microbatches=cfg.microbatches)
+    M, R = cfg.microbatches, cfg.mb_rows
+
+    def loss_fn(stacked, hp, x, t):
+        y = fwd(stacked, x)
+        ymb = y.reshape(M, R, cfg.dim)
+        tmb = t.reshape(M, R, 1)
+        per_mb = jax.vmap(
+            lambda ym, tm: head_loss(hp, ym, tm, microbatches=M))(ymb, tmb)
+        return jnp.sum(per_mb)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+    stacked = stack_stage_params(
+        [init_stage_params(cfg, s) for s in range(cfg.n_stages)])
+    hp = init_head_params(cfg)
+    losses = []
+    for k in range(cfg.steps):
+        x, t = step_batch(cfg, k)
+        loss, (gs, gh) = grad_fn(stacked, hp, x, t)
+        losses.append(float(loss))
+        stacked = jax.tree_util.tree_map(
+            lambda p, g: p - cfg.lr * g, stacked, gs)
+        hp = jax.tree_util.tree_map(lambda p, g: p - cfg.lr * g, hp, gh)
+    return losses
+
+
+# -------------------------------------------------------- worker entry --
+
+def _worker_main() -> int:
+    """Gang stage worker: ``python -m kubeflow_tpu.parallel.mpmd`` inside
+    a pod. Env contract: the reconciler's stage rendezvous stamps
+    (KFT_STAGE_ID / KFT_STAGE_BIND / KFT_STAGE_PREV / KFT_STAGE_NEXT —
+    see rendezvous/bootstrap.stage_from_env) + the KFT_MPMD_* run config.
+    Phases/heartbeats/spans ride the standard operator transports; the
+    stage report lands in KFT_MPMD_REPORT_DIR for the bench."""
+    from kubeflow_tpu.rendezvous.worker_check import _phase
+
+    phases: dict = {}
+    _phase(phases, "proc_start")
+    import jax
+
+    if os.environ.get("KFT_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_FORCE_PLATFORM"])
+
+    from kubeflow_tpu.obs.trace import SpanCollector
+    from kubeflow_tpu.rendezvous.bootstrap import (
+        depot_from_env, stage_from_env,
+    )
+    from kubeflow_tpu.training.loop import Heartbeat, post_heartbeat
+
+    _phase(phases, "imports_done")
+    info = stage_from_env()
+    if info is None:
+        print("KFT_NUM_STAGES not set: not an MPMD stage worker")
+        return 2
+    if info.stage_proc_id > 0:
+        # multi-worker stages carry the env contract (stage-local ranks,
+        # for per-stage jax.distributed groups on real slices) but this
+        # runner executes one process per stage — extra stage workers
+        # exit cleanly instead of racing proc 0 for the stage bind
+        print(f"stage {info.stage_id} proc {info.stage_proc_id}: "
+              "intra-stage worker groups are a future surface; proc 0 "
+              "owns the stage program")
+        return 0
+    cfg = PipelineRunConfig.from_env()
+    collector = SpanCollector(proc=f"stage{info.stage_id}")
+    chan = TCPStageChannel(
+        info.bind, prev=info.prev, next=info.next, stage=info.stage_id,
+        blocking=cfg.schedule == "gpipe", delay_s=cfg.dcn_delay_ms / 1e3,
+        collector=collector)
+    _phase(phases, "rendezvous_done")
+
+    dstats = DepotStats()
+    try:
+        depot = depot_from_env(stats=dstats)
+    except Exception:
+        dstats.inc("fetch_errors")
+        depot = None
+    rt = StageRuntime(cfg, info.stage_id, depot=depot, depot_stats=dstats)
+    phases["depot_hit"] = 1.0 if rt.depot_summary()["hit"] else 0.0
+    phases["stage_id"] = float(info.stage_id)
+    _phase(phases, "compile_done",
+           extra={"depot": dstats.snapshot()} if depot is not None else None)
+
+    hb_path = os.environ.get("KFT_HEARTBEAT_FILE")
+    hb = Heartbeat(hb_path) if hb_path else None
+
+    def on_step(step: int, loss: Optional[float]) -> None:
+        if "first_step_done" not in phases:
+            _phase(phases, "first_step_done")
+        if hb is not None:
+            hb.beat(step)
+
+    try:
+        result = run_stage(cfg, info.stage_id, chan, runtime=rt,
+                           collector=collector, on_step=on_step)
+    finally:
+        chan.close()
+        if hb is not None:
+            hb.close()
+
+    report_dir = os.environ.get("KFT_MPMD_REPORT_DIR")
+    if report_dir:
+        os.makedirs(report_dir, exist_ok=True)
+        path = os.path.join(report_dir,
+                            f"stage-{info.stage_id}.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(result), f)
+        os.replace(tmp, path)
+
+    # per-stage spans -> the operator job trace, over the ONE heartbeat
+    # http transport (training/loop.post_heartbeat). On shared-fs rigs
+    # KFT_HEARTBEAT_FILE is a file but the operator still injects its
+    # phases route as http — post to whichever is a URL. Bounded: the
+    # last step's ticks + transfers (the operator caps 64/POST).
+    span_url = next((u for u in (hb_path,
+                                 os.environ.get("KFT_PHASES_PATH"))
+                     if u and u.startswith(("http://", "https://"))), None)
+    if span_url:
+        spans = [s for s in collector.snapshot()
+                 if s["name"] in ("pipeline.tick", "dcn.transfer")]
+        last_step = cfg.steps - 1
+        chosen = [s for s in spans
+                  if s["attrs"].get("step") == last_step][:64]
+        post_heartbeat(span_url, step=cfg.steps, spans=chosen)
+    print(f"stage {info.stage_id}/{cfg.n_stages}: schedule={cfg.schedule} "
+          f"steps={cfg.steps} depot_hit={phases['depot_hit']} "
+          f"losses={result.losses}")
+    return 0
+
+
+def _oracle_main() -> int:
+    """``python -m kubeflow_tpu.parallel.mpmd --oracle``: run the SPMD
+    oracle for the env-described config and write its losses to
+    KFT_MPMD_REPORT_DIR/oracle.json (the bench's parity reference).
+    Needs XLA_FLAGS=--xla_force_host_platform_device_count >= stages."""
+    import jax
+
+    if os.environ.get("KFT_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_FORCE_PLATFORM"])
+    cfg = PipelineRunConfig.from_env()
+    losses = run_oracle(cfg)
+    report_dir = os.environ.get("KFT_MPMD_REPORT_DIR", ".")
+    os.makedirs(report_dir, exist_ok=True)
+    with open(os.path.join(report_dir, "oracle.json"), "w") as f:
+        json.dump({"losses": losses, "steps": cfg.steps,
+                   "microbatches": cfg.microbatches}, f)
+    print(f"oracle: losses={losses}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_oracle_main() if "--oracle" in sys.argv[1:]
+             else _worker_main())

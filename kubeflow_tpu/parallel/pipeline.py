@@ -17,7 +17,7 @@ same comms pattern).
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -29,9 +29,10 @@ except ImportError:                                 # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
-def stack_stage_params(per_stage_params: list) -> jax.Array:
+def stack_stage_params(per_stage_params: list) -> Any:
     """Stack per-stage parameter pytrees on a leading 'stage' dim: the result
-    is sharded over the pipeline axis so each device holds its stage only."""
+    is a PYTREE of the same structure (one stacked array per leaf), sharded
+    over the pipeline axis so each device holds its stage only."""
     return jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
@@ -132,10 +133,19 @@ def pipeline_apply(
         try:
             return shard_map(impl, axis_names=frozenset({axis}),
                              check_vma=False, **kwargs)
+        except TypeError:
+            pass
+        # jax 0.4.x spells the same thing inside-out: auto = the NON-
+        # manual axes (check_rep off — the replication checker predates
+        # per-axis tracking and rejects the scanned stage body)
+        try:
+            return shard_map(
+                impl, auto=frozenset(mesh.axis_names) - {axis},
+                check_rep=False, **kwargs)
         except TypeError as e:
             raise RuntimeError(
-                "partial_manual pipeline_apply needs jax>=0.9 "
-                "(shard_map axis_names support)") from e
+                "partial_manual pipeline_apply needs shard_map with "
+                "axis_names (jax>=0.9) or auto= (jax 0.4.x)") from e
     try:
         return shard_map(impl, check_vma=False, **kwargs)   # jax >= 0.8
     except TypeError:

@@ -37,7 +37,11 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _old
 
     def shard_map(f, mesh, in_specs, out_specs):
-        return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        # check_rep=False: the 0.4-era replication checker has no pcast
+        # to align constant-initialized scan carries with the varying
+        # inputs (the jax>=0.8 path matches them explicitly via pcast)
+        return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=False)
 
 
 NEG_INF = -1e30
@@ -68,9 +72,19 @@ def _block_attn_update(q, k, v, q_pos, k_pos, o, m, l, causal):
     return o_new, m_new, l_new
 
 
-def _ring_attention_shard(q, k, v, axis_name: str, causal: bool):
+def _axis_size(axis_name: str, static_size):
+    """Version-tolerant static axis size: ``jax.lax.axis_size`` only
+    exists on newer jax; older eras get the size from the caller's mesh
+    (it must be a static int — the ring permutation is built in Python)."""
+    if static_size is not None:
+        return int(static_size)
+    return jax.lax.axis_size(axis_name)
+
+
+def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
+                          axis_size=None):
     """Per-shard ring attention. q:[B,Sl,H,D] k,v:[B,Sl,KV,D] (local blocks)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name, axis_size)
     idx = jax.lax.axis_index(axis_name)
     b, sl, h, d = q.shape
     kvh = k.shape[2]
@@ -129,7 +143,8 @@ def ring_attention(
     qspec = P(batch_axes, axis, head_axis, None)
     kspec = P(batch_axes, axis, head_axis, None)
     fn = shard_map(
-        functools.partial(_ring_attention_shard, axis_name=axis, causal=causal),
+        functools.partial(_ring_attention_shard, axis_name=axis,
+                          causal=causal, axis_size=mesh.shape[axis]),
         mesh,
         in_specs=(qspec, kspec, kspec),
         out_specs=qspec,
@@ -137,12 +152,13 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def _ulysses_shard(q, k, v, axis_name: str, causal: bool):
+def _ulysses_shard(q, k, v, axis_name: str, causal: bool,
+                   axis_size=None):
     """Per-shard Ulysses: all_to_all seq-shard -> head-shard, full attention,
     reverse. q:[B,Sl,H,D] k,v:[B,Sl,KV,D]; requires KV % axis_size == 0."""
     from kubeflow_tpu.ops.attention import _xla_attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name, axis_size)  # noqa: F841  (layout contract)
     # [B,Sl,H,D] -> gather seq, scatter heads -> [B,S,H/n,D]
     qg = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -164,7 +180,8 @@ def ulysses_attention(
         )
     qspec = P(batch_axes, axis, head_axis, None)
     fn = shard_map(
-        functools.partial(_ulysses_shard, axis_name=axis, causal=causal),
+        functools.partial(_ulysses_shard, axis_name=axis,
+                          causal=causal, axis_size=mesh.shape[axis]),
         mesh,
         in_specs=(qspec, qspec, qspec),
         out_specs=qspec,

@@ -62,6 +62,53 @@ def load_downward_env(path: str = "/etc/podinfo/annotations",
     return out
 
 
+@dataclasses.dataclass
+class StageInfo:
+    """MPMD pipeline-stage rendezvous (parallel/mpmd.py): which stage
+    this worker belongs to and where its neighbors' transports live.
+    Stamped by the reconciler next to the jax.distributed world env when
+    a JAXJob's worker template carries KFT_NUM_STAGES — stage workers do
+    NOT join one jax.distributed world (that is the SPMD contract); each
+    stage is its own program, and these addresses are the activation /
+    grad-activation point-to-point links between them."""
+
+    stage_id: int
+    n_stages: int
+    bind: str                      # this stage's listen address
+    prev: Optional[str] = None     # stage_id-1's address (grads go here)
+    next: Optional[str] = None     # stage_id+1's address (acts go here)
+    stage_workers: int = 1         # workers per stage (multi-host stages)
+    stage_proc_id: int = 0         # rank within the stage's worker group
+
+    @property
+    def is_first(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.stage_id == self.n_stages - 1
+
+
+def stage_from_env(env: Optional[dict] = None) -> Optional[StageInfo]:
+    """Parse the stage rendezvous env (downward-API annotations folded in
+    like world_from_env). None when the job is not an MPMD pipeline."""
+    env = env if env is not None else os.environ
+    env = load_downward_env(env=env)
+    if "KFT_NUM_STAGES" not in env:
+        return None
+    n = int(env["KFT_NUM_STAGES"])
+    sid = int(env.get("KFT_STAGE_ID", "0"))
+    return StageInfo(
+        stage_id=sid,
+        n_stages=n,
+        bind=env.get("KFT_STAGE_BIND", "127.0.0.1:0"),
+        prev=env.get("KFT_STAGE_PREV") or None,
+        next=env.get("KFT_STAGE_NEXT") or None,
+        stage_workers=int(env.get("KFT_STAGE_WORKERS", "1")),
+        stage_proc_id=int(env.get("KFT_STAGE_PROC_ID", "0")),
+    )
+
+
 def world_from_env(env: Optional[dict] = None) -> WorldInfo:
     env = env if env is not None else os.environ
     env = load_downward_env(env=env)

@@ -67,6 +67,10 @@ class ByteBPETokenizer:
         self.eos_id = eos_id
         self._b2u = bytes_to_unicode()
         self._u2b = {u: b for b, u in self._b2u.items()}
+        # out-of-vocab ids decode as U+FFFD (see decode): the marker is
+        # stored in the byte-level alphabet so both decode paths emit the
+        # same UTF-8 bytes for it
+        self._oov_tok = "".join(self._b2u[b] for b in "�".encode())
         self._cache: dict[str, list[str]] = {}
         if self.special_tokens:
             self._special_re = re.compile(
@@ -137,6 +141,14 @@ class ByteBPETokenizer:
         for i in ids:
             tok = self.id_to_token.get(int(i))
             if tok is None:
+                # out-of-vocab id (a model whose vocab_size exceeds the
+                # tokenizer's can sample these): render U+FFFD instead of
+                # silently dropping the token — dropping breaks the
+                # "text position <-> token count" invariant the
+                # stop-string truncation (and any offset-based consumer)
+                # depends on. decode_bytes mirrors this as the UTF-8
+                # encoding of U+FFFD so predict and stream stay in parity.
+                out.append(self._oov_tok)
                 continue
             if int(i) in special_ids:
                 if not skip_special_tokens:
@@ -165,7 +177,13 @@ class ByteBPETokenizer:
         buf = bytearray()
         for i in ids:
             tok = self.id_to_token.get(int(i))
-            if tok is None or int(i) in special_ids:
+            if tok is None:
+                # out-of-vocab: the UTF-8 bytes of U+FFFD, matching
+                # decode()'s rendering (parity contract for incremental
+                # consumers like the stop-string matcher)
+                buf.extend("�".encode())
+                continue
+            if int(i) in special_ids:
                 continue
             for ch in tok:
                 buf.append(self._u2b.get(ch, ord("?")))

@@ -34,11 +34,13 @@ class FitResult:
     profile: Optional[dict] = None
 
 
-def post_heartbeat(url: str, step=None, warning=None,
+def post_heartbeat(url: str, step=None, warning=None, spans=None,
                    timeout: float = 5.0) -> bool:
-    """ONE http transport for the heartbeat contract (beats + warnings;
-    loop.Heartbeat and checkpoint's mirror alarm both route through
-    here). Failures are swallowed: missed beats ARE the failure signal."""
+    """ONE http transport for the heartbeat contract (beats + warnings +
+    worker-reported spans; loop.Heartbeat, checkpoint's mirror alarm and
+    the MPMD stage workers all route through here — the operator folds
+    ``spans`` into the /apis/v1/trace job trace). Failures are
+    swallowed: missed beats ARE the failure signal."""
     import json
     import urllib.request
 
@@ -47,6 +49,10 @@ def post_heartbeat(url: str, step=None, warning=None,
         body["step"] = int(step)
     if warning is not None:
         body["warning"] = warning
+    if spans:
+        # span dicts (obs/trace.Span.to_dict form); the operator
+        # validates field-by-field and bounds per pod
+        body["spans"] = list(spans)
     try:
         req = urllib.request.Request(
             url, method="POST", data=json.dumps(body).encode(),

@@ -310,3 +310,69 @@ def test_operator_injects_depot_env_on_shared_fs(operator):
         labels={"job-name": "j", "job-uid": "u1"}, env={}, command=[]))
     assert pod.env["KFT_DEPOT"] == operator.depot.path
     assert json.loads(json.dumps(pod.env))           # plain strings only
+
+
+# --------------------------------------------------- per-stage keys --
+# MPMD pipeline stages routinely lower to IDENTICAL HLO (same stage_fn,
+# same shapes — only param VALUES differ), so the stage index + stage
+# mesh are part of the fingerprint (ISSUE-15): one stage's executable
+# must never be served for another's key, and each stage's warm resubmit
+# must hit ITS entry.
+
+def test_same_hlo_different_stage_keys_never_collide(tmp_path):
+    txt = _lowered().as_text()
+    keys = {fingerprint(txt),
+            fingerprint(txt, stage=0),
+            fingerprint(txt, stage=1),
+            fingerprint(txt, stage=2)}
+    assert len(keys) == 4
+
+    depot = DirectoryDepot(str(tmp_path))
+    _, o0 = load_or_compile(_lowered(), depot, stage=0)
+    _, o1 = load_or_compile(_lowered(), depot, stage=1)
+    # identical HLO, two stages -> two independent publishes, not a hit
+    assert (o0, o1) == ("published", "published")
+    assert len(depot.keys()) == 2
+
+
+def test_stage_executable_warm_resubmit_hit(tmp_path):
+    depot = DirectoryDepot(str(tmp_path))
+    for stage in (0, 1):
+        _, outcome = load_or_compile(_lowered(), depot, stage=stage)
+        assert outcome == "published"
+    # warm resubmit: every stage deserializes ITS OWN entry
+    for stage in (0, 1):
+        s = DepotStats()
+        compiled, outcome = load_or_compile(_lowered(), depot,
+                                            stage=stage, stats=s)
+        assert outcome == "hit"
+        assert s.snapshot() == {"hits": 1}
+        assert _run(compiled)[0] == _run(_lowered().compile())[0]
+    # a THIRD stage with the same HLO still misses (no cross-stage serve)
+    s = DepotStats()
+    _, outcome = load_or_compile(_lowered(), depot, stage=2, stats=s)
+    assert outcome == "published"
+    assert s.get("misses") == 1
+
+
+def test_corrupt_stage_entry_counted_cold_fallback_and_heals(tmp_path):
+    depot = DirectoryDepot(str(tmp_path))
+    load_or_compile(_lowered(), depot, stage=1)
+    key = fingerprint(_lowered().as_text(), stage=1)
+    # corrupt ONLY stage 1's entry
+    depot.put(key, b"not a pickle", replace=True)
+
+    s = DepotStats()
+    compiled, outcome = load_or_compile(_lowered(), depot, stage=1, stats=s)
+    assert outcome == "published"            # healed via atomic replace
+    assert s.get("deserialize_failures") == 1
+    assert s.get("compiles") == 1            # counted local compile
+    assert _run(compiled)[0] == _run(_lowered().compile())[0]
+    # the heal really landed: next stage-1 worker hits again
+    s2 = DepotStats()
+    _, outcome2 = load_or_compile(_lowered(), depot, stage=1, stats=s2)
+    assert outcome2 == "hit"
+    # stage 0 was never affected by stage 1's corruption
+    s3 = DepotStats()
+    _, o3 = load_or_compile(_lowered(), depot, stage=0, stats=s3)
+    assert o3 == "published" and s3.get("deserialize_failures") == 0
