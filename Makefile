@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -254,6 +254,40 @@ test-pipeline:
 			+ ' 1f1b_2m=' + str(f) \
 			+ ' overlap=' + str(s['dcn_overlap_fraction']) \
 			+ ' oracle_drift=' + str(p['oracle_max_rel_diff']))"
+
+# quantized serving e2e (ISSUE 16): the quant suites (quantized-kernel
+# vs quantized-gather-oracle exactness incl. sharded tensor=2, write-path
+# scale growth, exact-parity proven bitwise, spec x quant token identity,
+# counted downgrades, per-config depot keys, KFT_QUANT_* env roundtrip)
+# plus the kernel parity suite unchanged, then the quant bench smoke.
+# Two independent teeth (like test-serving-sched): bench.py exits
+# nonzero unless int8-KV served real decode steps, teacher-forced greedy
+# agreement + max logit drift landed within the budgets STATED in the
+# same JSON, exact-parity mode proved bitwise, and the quantized
+# param_read roofline fields (bytes_per_weight / bytes_per_kv_token /
+# est_basis naming the quant config) are present; the JSON contract is
+# then re-checked from the captured file so a silently-loosened budget
+# or vanished field regresses visibly.
+QUANT_SMOKE_JSON := /tmp/kft-quant-smoke.json
+test-quant:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant.py \
+		tests/test_paged_attention_kernel.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --quant-smoke > $(QUANT_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(QUANT_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; q = e['quality']; b = e['param_read']; \
+		assert e['device_step_ms']['int8'] is not None, ('int8 never served', d); \
+		assert q['within_budget'] is True, ('quality outside budget', q); \
+		assert q['greedy_token_agreement'] >= q['greedy_agreement_budget'], q; \
+		assert q['max_logit_drift'] <= q['max_logit_drift_budget'], q; \
+		assert e['exact_parity_bitwise'] is True, ('parity hatch not bitwise', d); \
+		assert b['bytes_per_weight']['quantized'] < b['bytes_per_weight']['baseline'], b; \
+		assert b['bytes_per_kv_token']['quantized'] < b['bytes_per_kv_token']['baseline'], b; \
+		assert 'int8' in b['est_basis'], b; \
+		print('quant bench OK: agreement=' + str(q['greedy_token_agreement']) \
+			+ ' drift=' + str(q['max_logit_drift']) \
+			+ ' bytes/weight=' + str(b['bytes_per_weight']['quantized']) \
+			+ ' bytes/kv_token=' + str(b['bytes_per_kv_token']['quantized']))"
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -201,6 +201,46 @@ def param_logical_axes(cfg: LlamaConfig):
 
 
 # ---------------------------------------------------------------------------
+# int8 weight serving (serving/quant.py quantizes the tree; these helpers
+# are the per-tile dequant the serving call sites share)
+# ---------------------------------------------------------------------------
+
+def qmm(spec, x, tree, name, cfg: LlamaConfig):
+    """Matmul over an int8-quantized weight ``name`` (``name_q`` int8 +
+    ``name_s`` f32 per-output-channel scales in ``tree``): the HBM read
+    is one byte per param, the tile upcasts to the compute dtype inside
+    the fused einsum, and the scales multiply the OUTPUT tile — a dense
+    dequantized weight never exists."""
+    out = jnp.einsum(spec, x, tree[name + "_q"].astype(cfg.dtype))
+    return out * tree[name + "_s"].astype(cfg.dtype)
+
+
+def embed_tokens(params, tokens, cfg: LlamaConfig):
+    """Embedding lookup, quant-aware: int8 tables dequant the gathered
+    rows with their per-vocab-row scale. The unquantized branch is the
+    exact expression the call sites used before — the quant-off program
+    stays bitwise-identical."""
+    if "embed_q" in params:
+        rows = params["embed_q"].astype(cfg.dtype)[tokens]
+        return rows * params["embed_s"].astype(cfg.dtype)[tokens][..., None]
+    return params["embed"].astype(cfg.dtype)[tokens]
+
+
+def quant_head_logits(params, x, cfg: LlamaConfig):
+    """LM-head matmul over the int8 tree: tied embeddings reuse the
+    embedding table (its per-vocab-ROW scales become per-output-channel
+    scales of the transposed head); untied heads carry their own
+    per-vocab-channel scales. x: [..., D] -> [..., V] compute dtype."""
+    if cfg.tie_embeddings:
+        out = jnp.einsum("...d,dv->...v", x,
+                         params["embed_q"].T.astype(cfg.dtype))
+        return out * params["embed_s"].astype(cfg.dtype)
+    out = jnp.einsum("...d,dv->...v", x,
+                     params["lm_head_q"].astype(cfg.dtype))
+    return out * params["lm_head_s"].astype(cfg.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
@@ -217,6 +257,12 @@ def _ffn(h, lp, cfg: LlamaConfig, token_mask=None):
         y, aux = moe_layer(moe_params, h, cfg.moe_config(),
                            token_mask=token_mask)
         return y, moe_aux_total(aux)
+    if "w_gate_q" in lp:
+        gate = qmm("bsd,dm->bsm", h, lp, "w_gate", cfg)
+        up = qmm("bsd,dm->bsm", h, lp, "w_up", cfg)
+        ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "act_mlp"))
+        down = qmm("bsm,md->bsd", ff, lp, "w_down", cfg)
+        return down, jnp.zeros((), jnp.float32)
     gate = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"].astype(cfg.dtype))
     up = jnp.einsum("bsd,dm->bsm", h, lp["w_up"].astype(cfg.dtype))
     ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "act_mlp"))
@@ -337,14 +383,19 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
         cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
         original_max_seq=cfg.max_seq,
     ))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_tokens(params, tokens, cfg)
 
     def block(x, xs):
         lp, k_cache_l, v_cache_l = xs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        if "wq_q" in lp:
+            q = qmm("bsd,dhk->bshk", h, lp, "wq", cfg)
+            k = qmm("bsd,dhk->bshk", h, lp, "wk", cfg)
+            v = qmm("bsd,dhk->bshk", h, lp, "wv", cfg)
+        else:
+            q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+            k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+            v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         # honor the configured impl ("ring"/"ulysses" are training-only
@@ -354,7 +405,10 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
             else "pallas"
         o = attention(q, k, v, causal=True, impl=impl,
                       block_q=cfg.attn_block, block_kv=cfg.attn_block)
-        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        if "wo_q" in lp:
+            o = qmm("bshk,hkd->bsd", o, lp, "wo", cfg)
+        else:
+            o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         down, _ = _ffn(h, lp, cfg, token_mask=positions < lengths[:, None])
@@ -371,12 +425,16 @@ def prefill(params, tokens, cfg: LlamaConfig, cache, lengths=None):
         block, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     last = jnp.take_along_axis(
         x, jnp.maximum(lengths - 1, 0)[:, None, None].astype(jnp.int32),
         axis=1,
     )[:, 0]
-    logits = jnp.einsum("bd,dv->bv", last, head.astype(cfg.dtype))
+    if "embed_q" in params:
+        logits = quant_head_logits(params, last, cfg)
+    else:
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bd,dv->bv", last, head.astype(cfg.dtype))
     cache = {"k": new_k, "v": new_v, "len": lengths.astype(jnp.int32)}
     return logits.astype(jnp.float32), cache
 
@@ -390,14 +448,19 @@ def decode_step(params, token, cfg: LlamaConfig, cache):
         cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
         original_max_seq=cfg.max_seq,
     ))
-    x = params["embed"].astype(cfg.dtype)[token[:, None]]
+    x = embed_tokens(params, token[:, None], cfg)
 
     def block(x, xs):
         lp, k_cache_l, v_cache_l = xs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        if "wq_q" in lp:
+            q = qmm("bsd,dhk->bshk", h, lp, "wq", cfg)
+            k = qmm("bsd,dhk->bshk", h, lp, "wk", cfg)
+            v = qmm("bsd,dhk->bshk", h, lp, "wv", cfg)
+        else:
+            q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+            k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+            v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         # scatter the new KV row at each sequence's current length
@@ -406,7 +469,10 @@ def decode_step(params, token, cfg: LlamaConfig, cache):
         new_k = jnp.where(onehot, k.astype(k_cache_l.dtype), k_cache_l)
         new_v = jnp.where(onehot, v.astype(v_cache_l.dtype), v_cache_l)
         o = decode_attention(q, new_k, new_v, pos + 1)
-        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        if "wo_q" in lp:
+            o = qmm("bshk,hkd->bsd", o, lp, "wo", cfg)
+        else:
+            o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         down, _ = _ffn(h, lp, cfg, token_mask=(pos > 0)[:, None])
@@ -417,8 +483,12 @@ def decode_step(params, token, cfg: LlamaConfig, cache):
         block, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    if "embed_q" in params:
+        logits = quant_head_logits(params, x[:, 0], cfg)
+    else:
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
     return logits.astype(jnp.float32), {
         "k": new_k, "v": new_v, "len": cache["len"] + 1
     }

@@ -62,9 +62,16 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30      # same mask value as the gather path (decode_attention)
 
 
-def _decode_kernel(kvlen_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, scale, block_size, kv_heads,
-                   groups, head_dim):
+def _decode_kernel(kvlen_ref, tables_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, block_size, kv_heads, groups, head_dim,
+                   quantized=False):
+    if quantized:
+        # quantized pools ride with per-block per-kv-head scale tiles
+        # ([1, KV_H] f32, same index-map clipping as the pool blocks)
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
     kv_len = kvlen_ref[b]
@@ -88,9 +95,17 @@ def _decode_kernel(kvlen_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         rows = []
         for h in range(kv_heads):
             qh = q[h * groups:(h + 1) * groups]              # [G, D]
-            kh = k_ref[0, :, h * head_dim:(h + 1) * head_dim]
+            kh = k_ref[0, :, h * head_dim:(h + 1) * head_dim].astype(
+                jnp.float32)
+            if ks_ref is not None:
+                # dequant fused into the online-softmax inner loop: the
+                # int8/fp8 tile upcasts and multiplies its block's
+                # per-kv-head scale between DMA and the MXU — the exact
+                # per-element pipeline the gather oracle runs, so
+                # kernel-vs-oracle parity stays bit-for-bit in f32
+                kh = kh * ks_ref[0, h]
             rows.append(jax.lax.dot_general(
-                qh, kh.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))         # [G, block]
         s = jnp.concatenate(rows, axis=0)                    # [H, block]
         kv_pos = j * block_size + jax.lax.broadcasted_iota(
@@ -107,9 +122,12 @@ def _decode_kernel(kvlen_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         rows = []
         for h in range(kv_heads):
             ph = p[h * groups:(h + 1) * groups]              # [G, block]
-            vh = v_ref[0, :, h * head_dim:(h + 1) * head_dim]
+            vh = v_ref[0, :, h * head_dim:(h + 1) * head_dim].astype(
+                jnp.float32)
+            if vs_ref is not None:
+                vh = vh * vs_ref[0, h]
             rows.append(jax.lax.dot_general(
-                ph, vh.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                ph, vh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))         # [G, D]
         acc = acc_ref[...] * alpha[:, :1] + jnp.concatenate(rows, axis=0)
         m_ref[...], l_ref[...], acc_ref[...] = m_new, l_new, acc
@@ -121,7 +139,8 @@ def _decode_kernel(kvlen_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, k_pool, v_pool, tables, kv_len, *,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           k_scale=None, v_scale=None):
     """Block-resident paged GQA decode attention.
 
     q: [B, H, D] (this step's query rows); k_pool/v_pool:
@@ -129,7 +148,16 @@ def paged_decode_attention(q, k_pool, v_pool, tables, kv_len, *,
     row already scattered in); tables: [B, max_blocks_per_seq] int32 pool
     block ids in logical order; kv_len: [B] int32 live rows per slot
     INCLUDING this step. Returns [B, H, D] in q.dtype.
+
+    k_scale/v_scale: [num_blocks, KV_H] f32 per-block per-kv-head scales
+    of an int8/fp8-quantized pool (both or neither). When given, each
+    fetched pool tile dequants (upcast * scale) inside the online-
+    softmax inner loop — the scale tiles ride the same scalar-prefetch
+    index map as the pool blocks, so dead-tail iterations elide their
+    DMA too.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     b, h, d = q.shape
     num_blocks, block_size, kvh, d_k = k_pool.shape
     if d != d_k:
@@ -152,14 +180,29 @@ def paged_decode_attention(q, k_pool, v_pool, tables, kv_len, *,
         jc = jnp.clip(jnp.minimum(j, n_live - 1), 0, n_tables - 1)
         return (tables_ref[bi, jc], 0, 0)
 
+    def scale_map(bi, j, kvlen_ref, tables_ref):
+        n_live = pl.cdiv(kvlen_ref[bi], block_size)
+        jc = jnp.clip(jnp.minimum(j, n_live - 1), 0, n_tables - 1)
+        return (tables_ref[bi, jc], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda bi, j, *_: (bi, 0, 0)),
+        pl.BlockSpec((1, block_size, kvh * d), kv_map),
+        pl.BlockSpec((1, block_size, kvh * d), kv_map),
+    ]
+    args = (kv_len, tables, q, k2, v2)
+    if k_scale is not None:
+        if k_scale.shape != (num_blocks, kvh):
+            raise ValueError(f"k_scale shape {k_scale.shape} != "
+                             f"{(num_blocks, kvh)}")
+        in_specs += [pl.BlockSpec((1, kvh), scale_map),
+                     pl.BlockSpec((1, kvh), scale_map)]
+        args += (k_scale.astype(jnp.float32), v_scale.astype(jnp.float32))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, n_tables),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda bi, j, *_: (bi, 0, 0)),
-            pl.BlockSpec((1, block_size, kvh * d), kv_map),
-            pl.BlockSpec((1, block_size, kvh * d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda bi, j, *_: (bi, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, 128), jnp.float32),   # running max (lane-repl.)
@@ -169,13 +212,14 @@ def paged_decode_attention(q, k_pool, v_pool, tables, kv_len, *,
     )
     kernel = functools.partial(
         _decode_kernel, scale=1.0 / (d ** 0.5), block_size=block_size,
-        kv_heads=kvh, groups=groups, head_dim=d)
+        kv_heads=kvh, groups=groups, head_dim=d,
+        quantized=k_scale is not None)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=interpret,
-    )(kv_len, tables, q, k2, v2)
+    )(*args)
 
 
 def shard_unsupported_reason(mesh, n_kv_heads: int,
@@ -212,7 +256,8 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 def paged_decode_attention_sharded(q, k_pool, v_pool, tables, kv_len, *,
                                    mesh, axis: str = "tensor",
-                                   interpret: bool = False):
+                                   interpret: bool = False,
+                                   k_scale=None, v_scale=None):
     """``paged_decode_attention`` partitioned over the mesh's heads/KV
     axis with shard_map: q [B, H, D] shards on H, pools
     [NB, bs, KV_H, D] on KV_H, block tables and lengths replicated —
@@ -223,18 +268,38 @@ def paged_decode_attention_sharded(q, k_pool, v_pool, tables, kv_len, *,
     Falls back to the unwrapped kernel when the mesh doesn't shard
     ``axis`` (a 1-sized axis needs no partitioning); raises for
     topologies the kernel cannot shard (see shard_unsupported_reason) —
-    callers decide the gather downgrade, not this function."""
+    callers decide the gather downgrade, not this function.
+
+    Quantized pools: the [NB, KV_H] scale tables shard on their kv-head
+    dim with the pools (``P(None, axis)``) — each shard dequants its
+    local kv-head slice with its local scales, still zero collectives."""
     kvh = k_pool.shape[2]
     reason = shard_unsupported_reason(mesh, kvh, axis)
     if reason is not None:
         raise ValueError(f"cannot shard paged attention: {reason}")
     if mesh is None or int(dict(mesh.shape).get(axis, 1)) <= 1:
         return paged_decode_attention(q, k_pool, v_pool, tables, kv_len,
-                                      interpret=interpret)
-    kern = functools.partial(paged_decode_attention, interpret=interpret)
+                                      interpret=interpret,
+                                      k_scale=k_scale, v_scale=v_scale)
+    if k_scale is None:
+        kern = functools.partial(paged_decode_attention,
+                                 interpret=interpret)
+        wrapped = _shard_map(
+            kern, mesh,
+            in_specs=(P(None, axis, None), P(None, None, axis, None),
+                      P(None, None, axis, None), P(None, None), P(None)),
+            out_specs=P(None, axis, None))
+        return wrapped(q, k_pool, v_pool, tables, kv_len)
+
+    def kern(qs, kp, vp, t, kl, ks, vs):
+        return paged_decode_attention(qs, kp, vp, t, kl,
+                                      interpret=interpret,
+                                      k_scale=ks, v_scale=vs)
+
     wrapped = _shard_map(
         kern, mesh,
         in_specs=(P(None, axis, None), P(None, None, axis, None),
-                  P(None, None, axis, None), P(None, None), P(None)),
+                  P(None, None, axis, None), P(None, None), P(None),
+                  P(None, axis), P(None, axis)),
         out_specs=P(None, axis, None))
-    return wrapped(q, k_pool, v_pool, tables, kv_len)
+    return wrapped(q, k_pool, v_pool, tables, kv_len, k_scale, v_scale)

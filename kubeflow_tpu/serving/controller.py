@@ -283,6 +283,18 @@ class ServingController:
                 "KFT_SPEC_K": str(sp.spec_k),
                 "KFT_SPEC_DRAFTER": sp.spec_drafter,
             })
+        # quantized serving rides the same contract (serving/runtime.py
+        # quant_from_env); spec-level quant wins over the scheduler-embedded
+        # one, mirroring the engine's resolution order
+        qp = isvc.predictor.quant
+        if qp is None and isvc.predictor.scheduler is not None:
+            qp = isvc.predictor.scheduler.quant
+        if qp is not None:
+            predictor_env.update({
+                "KFT_QUANT_KV": qp.kv_dtype,
+                "KFT_QUANT_WEIGHTS": qp.weight_dtype,
+                "KFT_QUANT_EXACT_PARITY": "1" if qp.exact_parity else "0",
+            })
         predictor_env.setdefault("KFT_MODEL_DIR", "/mnt/models")
         # storage-initializer injection (the reference does this in a pod
         # webhook; here the ISVC controller stamps the init step directly)

@@ -174,12 +174,13 @@ class LLMModel(Model):
                  compile_cache_dir: Optional[str] = None,
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
                  tokenizer=None, request_timeout: float = 600.0,
-                 mesh=None, scheduler=None):
+                 mesh=None, scheduler=None, quant=None):
         super().__init__(name)
         self._params = params
         self.cfg = cfg
         self.mesh = mesh
         self.scheduler = scheduler     # SchedulerConfig / SchedulerPolicy
+        self.quant = quant             # QuantConfig / QuantPolicy
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.pad_id = pad_id
@@ -235,7 +236,7 @@ class LLMModel(Model):
             max_seq=self.max_seq,
             prefill_buckets=[b for b in self.prefill_buckets
                              if b <= self.max_seq] or [self.max_seq],
-            mesh=self.mesh, scheduler=self.scheduler)
+            mesh=self.mesh, scheduler=self.scheduler, quant=self.quant)
         t1 = time.perf_counter()
         self.load_seconds = round(t1 - t0, 3)
         # decode-program acquisition, depot-first (only when KFT_DEPOT is
@@ -325,6 +326,19 @@ class LLMModel(Model):
             # platform / unshardable mesh topology) is ~3.7x decode
             # bandwidth quietly lost — it must be visible on /metrics
             "kernel_downgrades_total": eng.kernel_downgrades,
+            # quantized serving: the ACTIVE (post-resolution) config plus
+            # what was requested — a fleet operator reading /v2 stats must
+            # be able to see a downgrade, not infer it from logs
+            "quant": {
+                "kv_dtype": eng.quant.kv_dtype,
+                "weight_dtype": eng.quant.weight_dtype,
+                "exact_parity": eng.quant.exact_parity,
+                "active": eng.quant.tag(),
+                "requested": (eng.quant_requested.tag()
+                              if eng.quant_requested is not None
+                              else "none"),
+            },
+            "quant_downgrades_total": eng.quant_downgrades,
             "sched": eng.scheduler_stats(),
             # request-latency distributions (obs/histogram.py): bucket
             # snapshots + p50/p95/p99 per family. The server renders

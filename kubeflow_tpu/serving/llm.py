@@ -32,7 +32,7 @@ from kubeflow_tpu.models import llama
 from kubeflow_tpu.obs import trace as obs_trace
 from kubeflow_tpu.obs.histogram import Histogram
 from kubeflow_tpu.serving.scheduler import (
-    SchedulerConfig, StepScheduler, ceil_pow2,
+    QuantConfig, SchedulerConfig, StepScheduler, ceil_pow2,
 )
 
 logger = logging.getLogger(__name__)
@@ -50,6 +50,19 @@ def _log_downgrade_once(requested: str, reason: str) -> None:
     logger.warning(
         "decode kernel %r downgraded to 'gather' (%s): losing the "
         "block-resident fast path's bandwidth advantage", requested, reason)
+
+
+def _log_quant_downgrade_once(requested: str, reason: str) -> None:
+    """Quant downgrades share the once-per-process set with kernel
+    downgrades: the fleet case is identical (128 replicas, one warning),
+    but the message must say WHICH dtype the engine is actually serving
+    at — a quant fallback is never a silent dtype change."""
+    if reason in _downgrades_logged:
+        return
+    _downgrades_logged.add(reason)
+    logger.warning(
+        "quant mode %s downgraded to unquantized (%s): serving at full "
+        "bytes-per-weight / bytes-per-KV-token", requested, reason)
 
 
 @dataclasses.dataclass
@@ -197,14 +210,17 @@ class LLMEngine:
                  kernel: str = "auto",
                  mesh=None,
                  scheduler: Optional[SchedulerConfig] = None,
+                 quant: Optional[QuantConfig] = None,
                  obs: Optional[obs_trace.SpanCollector] = None):
         from kubeflow_tpu.serving.paged_kv import (
             PagedKV, _lm_head as lm_head_fn, paged_prefill_chunk
             as paged_prefill_chunk_fn, paged_verify_step
             as paged_verify_step_fn, resolve_decode_kernel,
         )
+        from kubeflow_tpu.serving.quant import (
+            is_weight_quantized, quantize_weights, resolve_quant,
+        )
 
-        self.params = params
         self.cfg = cfg
         self.mesh = mesh
         # decode-attention path (paged_kv module docstring): the
@@ -223,6 +239,29 @@ class LLMEngine:
         if downgrade is not None:
             self.kernel_downgrades = 1
             _log_downgrade_once(kernel, downgrade)
+        # quantized serving (serving/quant.py): resolve the requested
+        # config against the platform/model. A mode the platform can't
+        # honor (no fp8 dtype) or the model can't (MoE expert weights)
+        # falls back to unquantized — counted on the SAME downgrade
+        # surface as kernel downgrades (kft_model_kernel_downgrades_total
+        # plus its own quant_downgrades), logged once per process, never
+        # a silent dtype change. The explicit quant= argument wins over
+        # the scheduler policy's copy (one resolution authority).
+        if quant is None and scheduler is not None:
+            quant = scheduler.quant
+        self.quant_requested = quant
+        self.quant, quant_downgrades = resolve_quant(quant, cfg=cfg)
+        self.quant_downgrades = len(quant_downgrades)
+        self.kernel_downgrades += self.quant_downgrades
+        for q_requested, q_reason in quant_downgrades:
+            _log_quant_downgrade_once(q_requested, q_reason)
+        if (self.quant.weight_dtype == "int8"
+                and not is_weight_quantized(params)):
+            # quantize ONCE at engine build (the LLMModel.load() path):
+            # per-output-channel scales; decode, chunked prefill, bucket
+            # prefill and spec verify all read the same int8 tree
+            params = quantize_weights(params, cfg)
+        self.params = params
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.buckets = sorted(b for b in prefill_buckets if b <= max_seq)
@@ -248,7 +287,7 @@ class LLMEngine:
                     f"every prefill bucket (got {b})")
         if kv_num_blocks is None:
             kv_num_blocks = max_batch * (max_seq // kv_block_size) + 1
-        kv_sh = len_sh = None
+        kv_sh = len_sh = sc_sh = None
         if mesh is not None:
             # tensor-parallel serving: the KV pool shards over the mesh's
             # `tensor` axis on the kv-head dim (matching the TP-sharded
@@ -267,10 +306,15 @@ class LLMEngine:
             kv_sh = NamedSharding(
                 mesh, PartitionSpec(None, None, None, "tensor", None))
             len_sh = NamedSharding(mesh, PartitionSpec())
+            # quantized pools: the [L, NB, KV] scale tables shard on the
+            # kv-head dim with the pool (same divisibility, checked above)
+            sc_sh = NamedSharding(mesh, PartitionSpec(None, None, "tensor"))
         self.paged = PagedKV(cfg=cfg, max_batch=max_batch, max_seq=max_seq,
                              block_size=kv_block_size,
                              num_blocks=kv_num_blocks,
-                             kv_sharding=kv_sh, len_sharding=len_sh)
+                             kv_sharding=kv_sh, len_sharding=len_sh,
+                             quant_kv=self.quant.kv_dtype,
+                             scale_sharding=sc_sh)
         self.cache = self.paged.cache
         self._free: list[int] = list(range(max_batch))
         self._active: dict[int, GenRequest] = {}     # slot -> request
@@ -460,9 +504,13 @@ class LLMEngine:
             jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.float32),
             jax.random.key(0), greedy_only=True, kernel=self.kernel,
             chunk_len=self.decode_chunk)
+        # the quant tag ALWAYS joins the fingerprint ("quant=off" when
+        # unquantized): same-HLO entries under different quant configs
+        # can never collide, and a warm claim's key-agnostic prefetch
+        # therefore lands the per-config executable automatically
         self._compiled_decode, outcome = load_or_compile(
             lowered, depot, mesh=self.mesh, stats=stats, wait_s=wait_s,
-            extra=("serving-decode",))
+            extra=("serving-decode", self.quant.tag()))
         self.depot_outcome = outcome
         return outcome
 

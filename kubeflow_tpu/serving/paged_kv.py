@@ -45,21 +45,44 @@ from kubeflow_tpu.models import llama
 from kubeflow_tpu.ops.attention import decode_attention
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
+from kubeflow_tpu.serving.quant import kv_store_dtype
 
 
 def init_paged_cache(cfg: llama.LlamaConfig, max_batch: int, max_seq: int,
                      block_size: int, num_blocks: int, dtype=None,
-                     kv_sharding=None, len_sharding=None) -> dict:
+                     kv_sharding=None, len_sharding=None,
+                     quant_kv: str = "none",
+                     scale_sharding=None) -> dict:
     """Pool + per-slot lengths. ``num_blocks`` bounds total resident tokens
     (num_blocks * block_size), independent of max_batch * max_seq.
     ``kv_sharding`` allocates the pool DIRECTLY with that sharding — a
-    pod-sized pool must never transit one chip unsharded."""
+    pod-sized pool must never transit one chip unsharded.
+
+    ``quant_kv`` != "none" stores the pools in the quantized dtype
+    ("int8" | "fp8_e4m3") and adds per-block per-kv-head f32 scale
+    tables ``k_scale``/``v_scale`` [L, num_blocks, KV] beside them (the
+    quantized-pool marker every dispatch path keys on is the presence of
+    those keys). ``scale_sharding`` shards the scale tables on the
+    kv-head dim alongside the pool's."""
     if max_seq % block_size:
         raise ValueError(f"max_seq={max_seq} not a multiple of "
                          f"block_size={block_size}")
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
              cfg.head_dim)
+    if quant_kv and quant_kv != "none":
+        sdtype = kv_store_dtype(quant_kv)
+        sshape = (cfg.n_layers, num_blocks, cfg.n_kv_heads)
+        return {
+            "k": jnp.zeros(shape, sdtype, device=kv_sharding),
+            "v": jnp.zeros(shape, sdtype, device=kv_sharding),
+            "k_scale": jnp.zeros(sshape, jnp.float32,
+                                 device=scale_sharding),
+            "v_scale": jnp.zeros(sshape, jnp.float32,
+                                 device=scale_sharding),
+            "len": jnp.zeros((max_batch,), jnp.int32,
+                             device=len_sharding),
+        }
     return {
         "k": jnp.zeros(shape, dtype, device=kv_sharding),
         "v": jnp.zeros(shape, dtype, device=kv_sharding),
@@ -247,12 +270,15 @@ class PagedKV:
     prefix_cache: bool = True
     kv_sharding: object = None       # NamedSharding for the pool k/v
     len_sharding: object = None
+    quant_kv: str = "none"           # "none" | "int8" | "fp8_e4m3"
+    scale_sharding: object = None    # NamedSharding for k_scale/v_scale
 
     def __post_init__(self):
         self.cache = init_paged_cache(
             self.cfg, self.max_batch, self.max_seq, self.block_size,
             self.num_blocks, kv_sharding=self.kv_sharding,
-            len_sharding=self.len_sharding)
+            len_sharding=self.len_sharding, quant_kv=self.quant_kv,
+            scale_sharding=self.scale_sharding)
         self.max_blocks_per_seq = self.max_seq // self.block_size
         self.tables = np.zeros(
             (self.max_batch, self.max_blocks_per_seq), np.int32)
@@ -374,11 +400,17 @@ class PagedKV:
 def _layer_qkv(lp, x, positions, cfg, inv_freq):
     """Shared attention-input path for the paged decode AND chunked-prefill
     layer bodies — one place for the projection/rope math so the two paths
-    cannot drift."""
+    cannot drift. int8-quantized layer trees (``wq_q`` present) run the
+    same einsums over the int8 tensors and scale the output tile."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+    if "wq_q" in lp:
+        q = llama.qmm("bsd,dhk->bshk", h, lp, "wq", cfg)
+        k = llama.qmm("bsd,dhk->bshk", h, lp, "wk", cfg)
+        v = llama.qmm("bsd,dhk->bshk", h, lp, "wv", cfg)
+    else:
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     return q, k, v
@@ -387,7 +419,10 @@ def _layer_qkv(lp, x, positions, cfg, inv_freq):
 def _layer_out(lp, x, o, cfg, token_mask=None):
     """Shared attention-output + FFN path (see _layer_qkv). token_mask
     keeps pad/idle rows out of MoE routing (capacity stealing)."""
-    o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+    if "wo_q" in lp:
+        o = llama.qmm("bshk,hkd->bsd", o, lp, "wo", cfg)
+    else:
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
     x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     down, _ = llama._ffn(h, lp, cfg, token_mask=token_mask)
@@ -396,9 +431,72 @@ def _layer_out(lp, x, o, cfg, token_mask=None):
 
 def _lm_head(params, x_last, cfg):
     x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    if "embed_q" in params:
+        return llama.quant_head_logits(params, x_last,
+                                       cfg).astype(jnp.float32)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     return jnp.einsum("bd,dv->bv", x_last,
                       head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+# ---- quantized-pool value path (int8 / fp8_e4m3 KV) ----
+
+def _kv_store(x, store_dtype):
+    """f32 values -> pool storage dtype: round+clip for int8, a plain
+    cast (round-to-nearest) for the fp8 emulation."""
+    if jnp.issubdtype(store_dtype, jnp.integer):
+        return jnp.clip(jnp.round(x), -127, 127).astype(store_dtype)
+    return x.astype(store_dtype)
+
+
+def _kv_qmax(store_dtype) -> float:
+    return 127.0 if jnp.issubdtype(store_dtype, jnp.integer) else 448.0
+
+
+def quant_scatter_rows(pool, scale, blk, off, rows):
+    """Quantize-on-write for the per-step KV scatters (decode, chunked
+    prefill, spec verify): write ``rows`` into the quantized ``pool`` at
+    (blk, off) under the per-block per-kv-head ``scale``, growing scales
+    monotonically (scatter-max) and requantizing each touched block's
+    resident rows when its scale grows — so earlier rows stay decodable
+    under the one scale the read path (kernel and oracle alike) applies.
+    When the scale does NOT grow the requant ratio is exactly 1.0 and
+    int8 content round-trips unchanged.
+
+    blk/off: int32, any common shape; rows: [..., KV, D]. Duplicate blk
+    entries (verify writing several rows of one slot's block) are
+    benign: the scatter-max folds all their amaxes first, every
+    duplicate then computes the identical grown scale and requantized
+    resident content, and the new rows land at distinct offsets. Rows
+    routed to the scratch block 0 only ever pollute scratch scales,
+    which nothing meaningful reads."""
+    blk = blk.reshape(-1)
+    off = off.reshape(-1)
+    rows = rows.reshape(blk.shape[0], *rows.shape[-2:]).astype(jnp.float32)
+    qmax = _kv_qmax(pool.dtype)
+    amax = jnp.max(jnp.abs(rows), axis=-1)               # [N, KV]
+    old = scale[blk]                                     # [N, KV]
+    scale = scale.at[blk].max(amax / qmax)
+    new = scale[blk]
+    safe = jnp.maximum(new, 1e-30)
+    ratio = jnp.where(new > 0, old / safe, 0.0)          # <= 1.0 always
+    resident = pool[blk].astype(jnp.float32) * ratio[:, None, :, None]
+    pool = pool.at[blk].set(_kv_store(resident, pool.dtype))
+    q = jnp.where(new[:, :, None] > 0, rows / safe[:, :, None], 0.0)
+    pool = pool.at[blk, off].set(_kv_store(q, pool.dtype))
+    return pool, scale
+
+
+def dequant_gather_view(pool, scale, tables, cfg):
+    """Slot-logical [B, T, KV, D] view of a QUANTIZED pool: gather the
+    table's blocks, upcast, multiply each block's per-kv-head scale,
+    cast to the compute dtype — element-for-element the pipeline the
+    Pallas kernel fuses into its inner loop, which is what keeps the
+    kernel-vs-oracle parity tests exact under quantization."""
+    b = tables.shape[0]
+    v = (pool[tables].astype(jnp.float32)
+         * scale[tables][:, :, None, :, None]).astype(cfg.dtype)
+    return v.reshape(b, -1, *pool.shape[2:])
 
 
 def paged_insert_batch(cache, k_new, v_new, blk_ids, lengths, slots):
@@ -409,10 +507,41 @@ def paged_insert_batch(cache, k_new, v_new, blk_ids, lengths, slots):
     blk_ids: [B, nb] pool destinations where id 0 means "skip this block"
     (already-resident shared prefix blocks and pad regions — the scratch
     block absorbs those writes); lengths/slots: [B] with slot < 0 marking
-    an inert pad row (its length write is redirected harmlessly)."""
+    an inert pad row (its length write is redirected harmlessly).
+
+    Quantized pools (``k_scale`` in cache) quantize-on-insert: per-block
+    per-kv-head amax over the incoming rows -> scale, values round/clip
+    into the storage dtype, scales scatter beside the pool. Rows past
+    each request's ``lengths`` are zeroed FIRST so pad garbage can never
+    inflate a final block's scale (pad rows are never attended)."""
     L = cache["k"].shape[0]
     bs = cache["k"].shape[2]
     b, nb = blk_ids.shape
+    if "k_scale" in cache:
+        qmax = _kv_qmax(cache["k"].dtype)
+        t = k_new.shape[2]
+        live = (jnp.arange(t)[None, :]
+                < lengths[:, None])[None, :, :, None, None]
+        kb = jnp.where(live, k_new, 0).astype(jnp.float32).reshape(
+            L, b, nb, bs, *k_new.shape[3:])
+        vb = jnp.where(live, v_new, 0).astype(jnp.float32).reshape(
+            L, b, nb, bs, *v_new.shape[3:])
+        ks = jnp.max(jnp.abs(kb), axis=(3, 5)) / qmax    # [L, B, nb, KV]
+        vs = jnp.max(jnp.abs(vb), axis=(3, 5)) / qmax
+        ksafe = jnp.maximum(ks, 1e-30)[:, :, :, None, :, None]
+        vsafe = jnp.maximum(vs, 1e-30)[:, :, :, None, :, None]
+        kq = _kv_store(jnp.where(ksafe > 1e-30, kb / ksafe, 0.0),
+                       cache["k"].dtype)
+        vq = _kv_store(jnp.where(vsafe > 1e-30, vb / vsafe, 0.0),
+                       cache["v"].dtype)
+        k = cache["k"].at[:, blk_ids].set(kq)
+        v = cache["v"].at[:, blk_ids].set(vq)
+        k_scale = cache["k_scale"].at[:, blk_ids].set(ks)
+        v_scale = cache["v_scale"].at[:, blk_ids].set(vs)
+        slots_drop = jnp.where(slots >= 0, slots, cache["len"].shape[0])
+        ln = cache["len"].at[slots_drop].set(lengths, mode="drop")
+        return {"k": k, "v": v, "k_scale": k_scale, "v_scale": v_scale,
+                "len": ln}
     kb = k_new.reshape(L, b, nb, bs, *k_new.shape[3:]).astype(
         cache["k"].dtype)
     vb = v_new.reshape(L, b, nb, bs, *v_new.shape[3:]).astype(
@@ -481,6 +610,7 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
     kernel, _ = resolve_decode_kernel(kernel, mesh=mesh,
                                       n_kv_heads=cfg.n_kv_heads)
     interpret = jax.default_backend() == "cpu"
+    quantized = "k_scale" in cache
     b = token.shape[0]
     bs = cache["k"].shape[2]
     pos = cache["len"]                                   # [B]
@@ -489,23 +619,34 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
         cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
         original_max_seq=cfg.max_seq,
     ))
-    x = params["embed"].astype(cfg.dtype)[token[:, None]]
+    x = llama.embed_tokens(params, token[:, None], cfg)
 
     batch = jnp.arange(b)
     blk = tables[batch, pos // bs]                       # [B] dest block
     off = pos % bs                                       # [B] row in block
 
     def block_fn(x, xs):
-        lp, k_pool, v_pool = xs                          # [NB, bs, KV, D]
+        if quantized:
+            lp, k_pool, v_pool, k_sc, v_sc = xs
+        else:
+            lp, k_pool, v_pool = xs                      # [NB, bs, KV, D]
+            k_sc = v_sc = None
         q, k, v = _layer_qkv(lp, x, positions, cfg, inv_freq)
         # scatter this step's KV row into each slot's current block
-        k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
-        v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
+        if quantized:
+            k_pool, k_sc = quant_scatter_rows(k_pool, k_sc, blk, off,
+                                              k[:, 0])
+            v_pool, v_sc = quant_scatter_rows(v_pool, v_sc, blk, off,
+                                              v[:, 0])
+        else:
+            k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
+            v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
         if kernel == "pallas":
             # block-resident kernel: per slot, only the live blocks named
             # by its table row move HBM->VMEM; no [max_seq] view exists.
             # Under a mesh the call shard_maps over the heads/KV axis —
-            # per-shard pool blocks, replicated tables, no collectives.
+            # per-shard pool blocks, replicated tables, no collectives
+            # (quantized scale tables shard on kv-heads with the pool).
             from kubeflow_tpu.ops.pallas_paged_attention import (
                 paged_decode_attention, paged_decode_attention_sharded,
             )
@@ -513,11 +654,19 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
             if mesh is not None:
                 o = paged_decode_attention_sharded(
                     q[:, 0], k_pool, v_pool, tables, pos + 1,
-                    mesh=mesh, interpret=interpret)[:, None]
+                    mesh=mesh, interpret=interpret,
+                    k_scale=k_sc, v_scale=v_sc)[:, None]
             else:
                 o = paged_decode_attention(
                     q[:, 0], k_pool, v_pool, tables, pos + 1,
-                    interpret=interpret)[:, None]
+                    interpret=interpret,
+                    k_scale=k_sc, v_scale=v_sc)[:, None]
+        elif quantized:
+            # the quantized gather oracle: dequant view, then the same
+            # dense attention — per-element identical to the kernel path
+            k_view = dequant_gather_view(k_pool, k_sc, tables, cfg)
+            v_view = dequant_gather_view(v_pool, v_sc, tables, cfg)
+            o = decode_attention(q, k_view, v_view, pos + 1)
         else:
             # gather each slot's logical view: block j of slot b holds
             # logical positions [j*bs, (j+1)*bs) — table order IS
@@ -526,9 +675,18 @@ def paged_decode_step(params, token, cfg: llama.LlamaConfig, cache, tables,
             v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
             o = decode_attention(q, k_view, v_view, pos + 1)
         # idle slots hold len 0: keep their garbage rows out of MoE routing
-        return _layer_out(lp, x, o, cfg,
-                          token_mask=(pos > 0)[:, None]), (k_pool, v_pool)
+        out = _layer_out(lp, x, o, cfg, token_mask=(pos > 0)[:, None])
+        if quantized:
+            return out, (k_pool, v_pool, k_sc, v_sc)
+        return out, (k_pool, v_pool)
 
+    if quantized:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            block_fn, x, (params["layers"], cache["k"], cache["v"],
+                          cache["k_scale"], cache["v_scale"]))
+        logits = _lm_head(params, x[:, 0], cfg)
+        return logits, {"k": new_k, "v": new_v, "k_scale": new_ks,
+                        "v_scale": new_vs, "len": cache["len"] + 1}
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
     logits = _lm_head(params, x[:, 0], cfg)
@@ -573,26 +731,47 @@ def paged_prefill_chunk(params, tokens, cfg: llama.LlamaConfig, cache,
         0)
     off = pos % bs
     positions = pos[None, :]
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = llama.embed_tokens(params, tokens, cfg)
+    quantized = "k_scale" in cache
 
     from kubeflow_tpu.ops.attention import _xla_attention
 
     def block_fn(x, xs):
-        lp, k_pool, v_pool = xs
+        if quantized:
+            lp, k_pool, v_pool, k_sc, v_sc = xs
+        else:
+            lp, k_pool, v_pool = xs
+            k_sc = v_sc = None
         q, k, v = _layer_qkv(lp, x, positions, cfg, inv_freq)
-        k_pool = k_pool.at[blk, off].set(k[0].astype(k_pool.dtype))
-        v_pool = v_pool.at[blk, off].set(v[0].astype(v_pool.dtype))
-        k_view = k_pool[tables[slot]].reshape(1, -1, *k_pool.shape[2:])
-        v_view = v_pool[tables[slot]].reshape(1, -1, *v_pool.shape[2:])
+        if quantized:
+            k_pool, k_sc = quant_scatter_rows(k_pool, k_sc, blk, off, k[0])
+            v_pool, v_sc = quant_scatter_rows(v_pool, v_sc, blk, off, v[0])
+            k_view = dequant_gather_view(k_pool, k_sc, tables[slot][None],
+                                         cfg)
+            v_view = dequant_gather_view(v_pool, v_sc, tables[slot][None],
+                                         cfg)
+        else:
+            k_pool = k_pool.at[blk, off].set(k[0].astype(k_pool.dtype))
+            v_pool = v_pool.at[blk, off].set(v[0].astype(v_pool.dtype))
+            k_view = k_pool[tables[slot]].reshape(1, -1, *k_pool.shape[2:])
+            v_view = v_pool[tables[slot]].reshape(1, -1, *v_pool.shape[2:])
         # the shared GQA causal kernel with traced query offset: row i
         # (absolute position offset+i) attends kv rows <= offset+i
         o = _xla_attention(q, k_view, v_view, causal=True, q_offset=offset)
-        return _layer_out(lp, x, o, cfg,
-                          token_mask=valid[None, :]), (k_pool, v_pool)
+        out = _layer_out(lp, x, o, cfg, token_mask=valid[None, :])
+        if quantized:
+            return out, (k_pool, v_pool, k_sc, v_sc)
+        return out, (k_pool, v_pool)
 
+    last_row = jnp.clip(length - offset - 1, 0, c - 1)
+    if quantized:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            block_fn, x, (params["layers"], cache["k"], cache["v"],
+                          cache["k_scale"], cache["v_scale"]))
+        return x[:, last_row], {"k": new_k, "v": new_v, "k_scale": new_ks,
+                                "v_scale": new_vs, "len": cache["len"]}
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
-    last_row = jnp.clip(length - offset - 1, 0, c - 1)
     return x[:, last_row], {"k": new_k, "v": new_v, "len": cache["len"]}
 
 
@@ -640,23 +819,49 @@ def paged_verify_step(params, tokens, cfg: llama.LlamaConfig, cache,
                jnp.clip(pos // bs, 0, tables.shape[1] - 1)],
         0)
     off = pos % bs
-    x = params["embed"].astype(cfg.dtype)[tokens]          # [B, S, D]
+    x = llama.embed_tokens(params, tokens, cfg)            # [B, S, D]
+    quantized = "k_scale" in cache
 
     from kubeflow_tpu.ops.attention import _xla_attention
 
     def block_fn(x, xs):
-        lp, k_pool, v_pool = xs
+        if quantized:
+            lp, k_pool, v_pool, k_sc, v_sc = xs
+        else:
+            lp, k_pool, v_pool = xs
+            k_sc = v_sc = None
         q, k, v = _layer_qkv(lp, x, pos, cfg, inv_freq)
-        k_pool = k_pool.at[blk, off].set(k.astype(k_pool.dtype))
-        v_pool = v_pool.at[blk, off].set(v.astype(v_pool.dtype))
-        k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
-        v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
+        if quantized:
+            # duplicate blk entries (several rows of one slot's block in
+            # a single verify) are safe: quant_scatter_rows folds their
+            # amaxes via scatter-max before any content write
+            k_pool, k_sc = quant_scatter_rows(k_pool, k_sc, blk, off, k)
+            v_pool, v_sc = quant_scatter_rows(v_pool, v_sc, blk, off, v)
+            k_view = dequant_gather_view(k_pool, k_sc, tables, cfg)
+            v_view = dequant_gather_view(v_pool, v_sc, tables, cfg)
+        else:
+            k_pool = k_pool.at[blk, off].set(k.astype(k_pool.dtype))
+            v_pool = v_pool.at[blk, off].set(v.astype(v_pool.dtype))
+            k_view = k_pool[tables].reshape(b, -1, *k_pool.shape[2:])
+            v_view = v_pool[tables].reshape(b, -1, *v_pool.shape[2:])
         # per-slot query offsets: row s (position start[b]+s) attends kv
         # rows <= start[b]+s — this step's own earlier rows included,
         # every stale/rejected row beyond them masked
         o = _xla_attention(q, k_view, v_view, causal=True, q_offset=start)
-        return _layer_out(lp, x, o, cfg, token_mask=valid), (k_pool, v_pool)
+        out = _layer_out(lp, x, o, cfg, token_mask=valid)
+        if quantized:
+            return out, (k_pool, v_pool, k_sc, v_sc)
+        return out, (k_pool, v_pool)
 
+    if quantized:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            block_fn, x, (params["layers"], cache["k"], cache["v"],
+                          cache["k_scale"], cache["v_scale"]))
+        d = x.shape[-1]
+        logits = _lm_head(params, x.reshape(b * s, d),
+                          cfg).reshape(b, s, -1)
+        return logits, {"k": new_k, "v": new_v, "k_scale": new_ks,
+                        "v_scale": new_vs, "len": cache["len"]}
     x, (new_k, new_v) = jax.lax.scan(
         block_fn, x, (params["layers"], cache["k"], cache["v"]))
     d = x.shape[-1]

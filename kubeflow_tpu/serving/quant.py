@@ -1,0 +1,152 @@
+"""Quantized serving: config resolution + int8 weight quantization.
+
+Two quantization surfaces, both configured by ``QuantConfig``
+(serving/scheduler.py) and both with a hard exact-parity escape hatch:
+
+- **Paged-KV pools** (int8 or an fp8-shaped e4m3 emulation): storage and
+  per-block per-kv-head scales live in serving/paged_kv.py; the dequant
+  is fused into the Pallas online-softmax inner loop
+  (ops/pallas_paged_attention.py) and, identically, into the gather
+  oracle's view — so kernel-vs-oracle parity tests keep working
+  quantized.
+- **Weights** (int8, per-output-channel scales): quantized ONCE here on
+  the load path; the matmul call sites (models/llama.py,
+  serving/paged_kv.py) read the int8 tensor, upcast the tile inside the
+  fused einsum, and multiply the OUTPUT tile by the channel scales —
+  never materializing a dense dequantized copy.
+
+``resolve_quant`` is the single downgrade authority: a requested mode
+the platform or model can't honor resolves to the unquantized path WITH
+a reason the engine counts (kernel_downgrades / quant_downgrades) and
+logs once per process — never a silent dtype change.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from kubeflow_tpu.serving.scheduler import QuantConfig
+
+# Symmetric-quant clip points per KV storage dtype. fp8_e4m3's 448 is
+# the e4m3fn finite max; int8 clips at +/-127 (keeping -128 unused makes
+# the scale-growth requant in paged_kv exactly symmetric).
+KV_QMAX = {"int8": 127.0, "fp8_e4m3": 448.0}
+WEIGHT_QMAX = 127.0
+
+# Big quantizable matmul weights and the axes their per-output-channel
+# scales reduce over (layer tensors carry a leading L axis; the scale
+# keeps it so lax.scan slicing still works). Norm vectors and the MoE
+# router stay full precision — tiny, and routing exactness matters.
+_LAYER_WEIGHTS = {
+    # name: contraction axes (excluding the leading L axis)
+    "wq": (1,),        # [L, d, h, hd]  -> scale [L, h, hd]
+    "wk": (1,),        # [L, d, kv, hd] -> scale [L, kv, hd]
+    "wv": (1,),        # [L, d, kv, hd] -> scale [L, kv, hd]
+    "wo": (1, 2),      # [L, h, hd, d]  -> scale [L, d]
+    "w_gate": (1,),    # [L, d, m]      -> scale [L, m]
+    "w_up": (1,),      # [L, d, m]      -> scale [L, m]
+    "w_down": (1,),    # [L, m, d]      -> scale [L, d]
+}
+
+
+def kv_store_dtype(kv_dtype: str):
+    """jnp dtype the quantized pool is stored in."""
+    if kv_dtype == "int8":
+        return jnp.int8
+    if kv_dtype == "fp8_e4m3":
+        return jnp.float8_e4m3fn
+    raise ValueError(f"no storage dtype for kv_dtype={kv_dtype!r}")
+
+
+def fp8_unsupported_reason(platform: Optional[str] = None) -> Optional[str]:
+    """None when the fp8-shaped e4m3 emulation can run here. The gate is
+    dtype availability: the emulation only needs XLA convert, so any
+    platform whose jax ships float8_e4m3fn qualifies (including CPU
+    interpret mode)."""
+    del platform  # dtype presence is the platform gate today
+    if not hasattr(jnp, "float8_e4m3fn"):
+        return "this jax build has no float8_e4m3fn dtype"
+    return None
+
+
+def resolve_quant(quant: Optional[QuantConfig], cfg=None,
+                  platform: Optional[str] = None,
+                  ) -> Tuple[QuantConfig, List[Tuple[str, str]]]:
+    """Resolve a requested quant config against platform/model support.
+
+    Returns ``(effective, downgrades)`` where downgrades is a list of
+    ``(requested_mode, reason)`` pairs — one per mode that fell back to
+    unquantized. ``None`` and ``exact_parity=True`` resolve to all-off
+    with NO downgrade (the caller asked for the unquantized program).
+    """
+    if quant is None:
+        return QuantConfig(), []
+    quant.validate()
+    if quant.exact_parity:
+        return QuantConfig(exact_parity=True), []
+    kv, w = quant.kv_dtype, quant.weight_dtype
+    downgrades: List[Tuple[str, str]] = []
+    if kv == "fp8_e4m3":
+        reason = fp8_unsupported_reason(platform)
+        if reason is not None:
+            downgrades.append((f"kv_dtype={kv}", reason))
+            kv = "none"
+    if w == "int8" and cfg is not None and getattr(cfg, "n_experts", 0):
+        downgrades.append((
+            f"weight_dtype={w}",
+            "MoE expert weights keep full precision (the routed expert "
+            "einsums are not int8-lowered)"))
+        w = "none"
+    return QuantConfig(kv_dtype=kv, weight_dtype=w), downgrades
+
+
+def is_weight_quantized(params) -> bool:
+    """True when the tree already carries int8 weight keys (idempotence
+    guard for engine rebuilds over a shared quantized tree)."""
+    return "embed_q" in params
+
+
+def _quantize_channels(w, axes) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 per-output-channel quantization: amax over the
+    contraction ``axes`` -> scale, round/clip -> int8. Returns
+    (q int8, scale f32 with ``axes`` squeezed out)."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=axes) / WEIGHT_QMAX
+    s = jnp.maximum(s, 1e-12)  # all-zero channels quantize to 0 cleanly
+    s_b = jnp.expand_dims(s, axes)
+    q = jnp.clip(jnp.round(w32 / s_b), -WEIGHT_QMAX,
+                 WEIGHT_QMAX).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
+def quantize_weights(params, cfg):
+    """int8-quantize the big matmul weights ONCE (the LLMModel.load()
+    path). Each quantized tensor ``name`` is replaced by ``name_q``
+    (int8) + ``name_s`` (f32 per-output-channel scales); everything else
+    (norms, router) passes through untouched. Call sites detect the
+    ``_q`` keys and fuse the channel scales into the output tile.
+
+    The embedding quantizes per vocab ROW (each token's vector gets one
+    scale): the lookup dequants with one scalar per gathered row, and a
+    tied LM head gets per-vocab-channel output scaling from the same
+    table. MoE configs must be downgraded before calling (resolve_quant
+    does this)."""
+    if getattr(cfg, "n_experts", 0):
+        raise ValueError("int8 weights unsupported for MoE configs; "
+                         "resolve_quant should have downgraded")
+    out = {"final_norm": params["final_norm"]}
+    out["embed_q"], out["embed_s"] = _quantize_channels(params["embed"],
+                                                        (1,))
+    if not cfg.tie_embeddings:
+        # [d, V] -> per-vocab-output-channel scale [V]
+        out["lm_head_q"], out["lm_head_s"] = _quantize_channels(
+            params["lm_head"], (0,))
+    layers = dict(params["layers"])
+    for name, axes in _LAYER_WEIGHTS.items():
+        w = layers.pop(name)
+        layers[name + "_q"], layers[name + "_s"] = _quantize_channels(
+            w, axes)
+    out["layers"] = layers
+    return out

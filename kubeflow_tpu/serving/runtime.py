@@ -38,6 +38,14 @@ Env contract (all optional except the uri for real weights):
   KFT_SPEC_K                 max draft tokens per verify step (default 4)
   KFT_SPEC_DRAFTER           drafter name (default "ngram" =
                              prompt-lookup, zero extra weights)
+  KFT_QUANT_KV               paged-KV pool storage dtype: "int8" or
+                             "fp8_e4m3" (unset/"none" = unquantized)
+  KFT_QUANT_WEIGHTS          weight dtype: "int8" (unset/"none" =
+                             unquantized; quantized once at load,
+                             per-output-channel scales)
+  KFT_QUANT_EXACT_PARITY     "1" forces BOTH quant paths off — the
+                             engine program is bitwise-identical to an
+                             unconfigured one (the parity escape hatch)
   KFT_DEPOT                  executable depot (dir path or operator http
                              URL, parallel/depot.py): load() acquires the
                              steady-state decode program depot-first, so
@@ -100,6 +108,22 @@ def scheduler_from_env(env: Mapping[str, str]):
             or defaults.spec_drafter)
 
 
+def quant_from_env(env: Mapping[str, str]):
+    """KFT_QUANT_KV / KFT_QUANT_WEIGHTS / KFT_QUANT_EXACT_PARITY ->
+    QuantConfig (None when nothing is set — the engine then serves
+    unquantized with a program bitwise-identical to pre-quant builds)."""
+    from kubeflow_tpu.serving.scheduler import QuantConfig
+
+    keys = ("KFT_QUANT_KV", "KFT_QUANT_WEIGHTS", "KFT_QUANT_EXACT_PARITY")
+    if not any(env.get(k) for k in keys):
+        return None
+    return QuantConfig(
+        kv_dtype=env.get("KFT_QUANT_KV", "") or "none",
+        weight_dtype=env.get("KFT_QUANT_WEIGHTS", "") or "none",
+        exact_parity=env.get("KFT_QUANT_EXACT_PARITY", "") not in
+            ("", "0", "false", "no"))
+
+
 def build_model_from_env(env: Mapping[str, str]) -> Model:
     """Construct the Model the env contract describes (runtime selection
     having already happened in the ISVC controller)."""
@@ -127,7 +151,8 @@ def build_model_from_env(env: Mapping[str, str]) -> Model:
             max_batch=int(env.get("KFT_MAX_BATCH", 8)),
             max_seq=int(env.get("KFT_MAX_SEQ", 1024)),
             compile_cache_dir=cache,
-            scheduler=scheduler_from_env(env))
+            scheduler=scheduler_from_env(env),
+            quant=quant_from_env(env))
     raise ValueError(f"unsupported KFT_MODEL_FORMAT {fmt!r}")
 
 

@@ -36,6 +36,58 @@ from typing import Optional
 
 
 @dataclasses.dataclass
+class QuantConfig:
+    """Quantized-serving knobs (serving/quant.py resolves them against
+    the platform/model; the API layer re-exports this as the
+    predictor-spec ``QuantPolicy`` and the ISVC controller stamps it as
+    KFT_QUANT_KV / KFT_QUANT_WEIGHTS / KFT_QUANT_EXACT_PARITY).
+
+    kv_dtype: paged-KV pool storage — "none" | "int8" | "fp8_e4m3".
+        int8/fp8 pools carry per-block per-kv-head scales beside the
+        pool; dequant is fused into the Pallas online-softmax inner
+        loop (and into the gather oracle's view, identically).
+    weight_dtype: model weights — "none" | "int8". int8 quantizes ONCE
+        on the load path with per-output-channel scales; every matmul
+        (decode, chunked prefill, spec verify, bucket prefill) reads
+        the int8 tensor and scales the output tile.
+    exact_parity: escape hatch — forces BOTH paths off regardless of
+        the dtypes above. The resulting programs are bitwise-identical
+        to an engine that never heard of quantization (no downgrade is
+        counted: the caller asked for parity).
+    """
+
+    kv_dtype: str = "none"
+    weight_dtype: str = "none"
+    exact_parity: bool = False
+
+    KV_DTYPES = ("none", "int8", "fp8_e4m3")
+    WEIGHT_DTYPES = ("none", "int8")
+
+    def validate(self) -> None:
+        if self.kv_dtype not in self.KV_DTYPES:
+            raise ValueError(f"kv_dtype={self.kv_dtype!r} "
+                             f"(want one of {self.KV_DTYPES})")
+        if self.weight_dtype not in self.WEIGHT_DTYPES:
+            raise ValueError(f"weight_dtype={self.weight_dtype!r} "
+                             f"(want one of {self.WEIGHT_DTYPES})")
+
+    @property
+    def enabled(self) -> bool:
+        return (not self.exact_parity
+                and (self.kv_dtype != "none"
+                     or self.weight_dtype != "none"))
+
+    def tag(self) -> str:
+        """Depot-fingerprint token: precompiled executables under
+        different quant configs must never collide, even when parity-off
+        lowers to byte-identical HLO — the tag joins the fingerprint's
+        ``extra`` tuple so the keys differ by construction."""
+        if not self.enabled:
+            return "quant=off"
+        return f"quant=kv:{self.kv_dtype},w:{self.weight_dtype}"
+
+
+@dataclasses.dataclass
 class SchedulerConfig:
     """Knobs for the continuous-batching step scheduler.
 
@@ -73,6 +125,9 @@ class SchedulerConfig:
     spec_decode: bool = False
     spec_k: int = 3
     spec_drafter: str = "ngram"
+    # quantized serving (see QuantConfig above). None = unquantized.
+    # LLMEngine's explicit quant= argument wins when both are set.
+    quant: Optional[QuantConfig] = None
 
 
 def ceil_pow2(n: int) -> int:

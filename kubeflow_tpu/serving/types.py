@@ -20,6 +20,10 @@ from kubeflow_tpu.api.types import TPUSpec
 # interleaving, adaptive decode-chunk trims, radix prefix cache, and the
 # speculative-decoding knobs (spec_decode / spec_k / spec_drafter).
 from kubeflow_tpu.serving.scheduler import SchedulerConfig as SchedulerPolicy
+# The predictor-spec view of the quantized-serving config (also pure
+# stdlib): KV dtype, weight dtype, exact-parity escape hatch — stamped as
+# KFT_QUANT_* onto the predictor pod by the ISVC controller.
+from kubeflow_tpu.serving.scheduler import QuantConfig as QuantPolicy
 
 
 @dataclasses.dataclass
@@ -90,6 +94,10 @@ class PredictorSpec:
     # KFT_ADAPTIVE_DECODE_CHUNK / KFT_RADIX_CACHE / KFT_SPEC_DECODE /
     # KFT_SPEC_K / KFT_SPEC_DRAFTER by the ISVC controller
     scheduler: Optional[SchedulerPolicy] = None
+    # quantized serving, stamped as KFT_QUANT_KV / KFT_QUANT_WEIGHTS /
+    # KFT_QUANT_EXACT_PARITY by the ISVC controller; resolution (platform
+    # support, downgrade counting) happens in the replica's engine
+    quant: Optional[QuantPolicy] = None
 
 
 @dataclasses.dataclass
@@ -141,12 +149,20 @@ def inference_service_from_dict(d: dict) -> InferenceService:
         tpu = TPUSpec(**tpu)
     sched = p.pop("scheduler", None)
     if isinstance(sched, dict):
+        sched = dict(sched)
+        sq = sched.pop("quant", None)
+        if isinstance(sq, dict):
+            sq = QuantPolicy(**sq)
         sched = SchedulerPolicy(**sched)
+        sched.quant = sq
+    quant = p.pop("quant", None)
+    if isinstance(quant, dict):
+        quant = QuantPolicy(**quant)
     slo = p.pop("canary_slo", None)
     if isinstance(slo, dict):
         slo = CanarySLO(**slo)
     predictor = PredictorSpec(model_format=fmt, tpu=tpu, scheduler=sched,
-                              canary_slo=slo, **p)
+                              quant=quant, canary_slo=slo, **p)
     return InferenceService(
         name=d["name"], namespace=d.get("namespace", "default"),
         labels=dict(d.get("labels", {})), predictor=predictor)

@@ -1,0 +1,602 @@
+"""Quantized serving (ISSUE 16): int8 paged-KV + int8 weights fused into
+the decode path.
+
+The contract under test, layer by layer:
+
+- the QUANTIZED pallas kernel (scales as extra Pallas inputs, dequant
+  fused before the dot) matches a QUANTIZED gather oracle running the
+  identical dequant pipeline — EXACTLY, because both feed the same f32
+  values into the same dot;
+- quantize-on-insert / quantize-on-scatter keep pool contents within one
+  quantization step of the real KV, with pad rows masked out of the
+  scales and scale growth monotone;
+- exact-parity mode is STRUCTURAL: a QuantConfig(exact_parity=True)
+  engine builds the very same program (no quant keys anywhere), proven
+  bitwise on tokens and pool contents;
+- spec decode over a quantized pool stays token-identical to plain
+  decode under the same quant config;
+- unsupported modes downgrade to unquantized WITH counted reasons
+  (kernel_downgrades / stats), never silently;
+- the depot fingerprints fold the quant tag: per-config executables
+  never collide and corrupt entries heal;
+- the QuantConfig rides PredictorSpec -> ISVC controller KFT_QUANT_* env
+  stamps -> runtime.quant_from_env, mirroring the PR 6/7 knob contract.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.ops.pallas_paged_attention import paged_decode_attention
+from kubeflow_tpu.serving import paged_kv
+from kubeflow_tpu.serving.quant import (
+    is_weight_quantized, quantize_weights, resolve_quant,
+)
+from kubeflow_tpu.serving.scheduler import QuantConfig, SchedulerConfig
+
+from test_paged_attention_kernel import _gather_ref, _pool_case
+
+
+# ------------------------------------------------------------ helpers --
+
+def _quantize_pool(pool, qmax=127.0, dtype=jnp.int8):
+    """Per-block per-kv-head symmetric quantization of a full-precision
+    [NB, bs, KVH, D] pool -> (q pool, scale [NB, KVH] f32)."""
+    amax = jnp.max(jnp.abs(pool.astype(jnp.float32)), axis=(1, 3))
+    scale = jnp.maximum(amax / qmax, 1e-30)
+    q = pool.astype(jnp.float32) / scale[:, None, :, None]
+    if jnp.issubdtype(dtype, jnp.integer):
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    return q.astype(dtype), scale.astype(jnp.float32)
+
+
+def _dequant(pool, scale):
+    return pool.astype(jnp.float32) * scale[:, None, :, None]
+
+
+def _quant_case(key, **kw):
+    q, kp, vp, tables, kvl = _pool_case(key, **kw)
+    kq, ks = _quantize_pool(kp)
+    vq, vs = _quantize_pool(vp)
+    return q, kq, vq, ks, vs, tables, kvl
+
+
+def _assert_quant_parity(case):
+    """The tentpole property, two teeth: (a) the quantized kernel is
+    BITWISE the unquantized kernel fed the dequant VIEW of the same pool
+    (the fused `int8 -> f32 -> * scale` happens before the dot, so
+    fusing it changed nothing); (b) it matches the gather oracle over
+    the same view at the suite's standard f32 tolerance (the oracle is
+    an independent softmax implementation — exactly like the
+    unquantized parity tests)."""
+    q, kq, vq, ks, vs, tables, kvl = case
+    kd = _dequant(kq, ks).astype(q.dtype)
+    vd = _dequant(vq, vs).astype(q.dtype)
+    out = paged_decode_attention(q, kq, vq, tables, kvl, interpret=True,
+                                 k_scale=ks, v_scale=vs)
+    fused_ref = paged_decode_attention(q, kd, vd, tables, kvl,
+                                       interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(fused_ref))
+    ref = _gather_ref(q, kd, vd, tables, kvl)
+    live = np.asarray(kvl) > 0
+    np.testing.assert_allclose(np.asarray(out)[live],
+                               np.asarray(ref)[live], rtol=2e-5, atol=2e-5)
+    assert bool(jnp.isfinite(out).all())
+
+
+# ----------------------------------------------- kernel-vs-oracle parity --
+
+def test_quantized_kernel_exact_vs_quantized_gather_oracle_ragged():
+    """Ragged lengths, idle (len 0) slots, fresh slots, exact-block and
+    cross-block-boundary lengths — the full decode geometry zoo, int8."""
+    _assert_quant_parity(_quant_case(
+        jax.random.key(10), b=8, h=4, kvh=2, d=32, bs=8, nbp=3,
+        kv_len=[0, 1, 5, 8, 9, 24, 0, 13]))
+
+
+def test_quantized_kernel_gqa_groups():
+    """GQA grouping quantized: 2 query heads per KV head — the group's
+    shared K tile dequants ONCE per kv head, every group member exact."""
+    _assert_quant_parity(_quant_case(
+        jax.random.key(11), b=5, h=4, kvh=2, d=64, bs=16, nbp=4,
+        kv_len=[1, 7, 16, 17, 64]))
+
+
+def test_quantized_kernel_scale_shape_validation():
+    q, kq, vq, ks, vs, tables, kvl = _quant_case(
+        jax.random.key(12), b=2, h=4, kvh=2, d=32, bs=8, nbp=2,
+        kv_len=[4, 4])
+    with pytest.raises(ValueError, match="scale"):
+        paged_decode_attention(q, kq, vq, tables, kvl, interpret=True,
+                               k_scale=ks)            # one without the other
+    with pytest.raises(ValueError, match="scale"):
+        paged_decode_attention(q, kq, vq, tables, kvl, interpret=True,
+                               k_scale=ks[:, :1], v_scale=vs)
+
+
+@pytest.mark.skipif(not hasattr(jnp, "float8_e4m3fn"),
+                    reason="no float8_e4m3fn in this jax build")
+def test_quantized_kernel_fp8_pool():
+    """The fp8-shaped e4m3 emulation through the same fused-dequant path:
+    still exact vs the dequant-view oracle (identical float pipeline)."""
+    q, kp, vp, tables, kvl = _pool_case(
+        jax.random.key(13), b=3, h=4, kvh=2, d=32, bs=8, nbp=2,
+        kv_len=[4, 9, 16])
+    kq, ks = _quantize_pool(kp, qmax=448.0, dtype=jnp.float8_e4m3fn)
+    vq, vs = _quantize_pool(vp, qmax=448.0, dtype=jnp.float8_e4m3fn)
+    _assert_quant_parity((q, kq, vq, ks, vs, tables, kvl))
+
+
+def test_sharded_quantized_kernel_tensor2():
+    """shard_map'd quantized kernel, tensor=2: pools AND scale tables
+    shard on the kv-head dim, zero new collectives, output matches the
+    unsharded dequant-view oracle."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from kubeflow_tpu.ops.pallas_paged_attention import (
+        paged_decode_attention_sharded,
+    )
+    from kubeflow_tpu.parallel import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(tensor=2))
+    q, kq, vq, ks, vs, tables, kvl = _quant_case(
+        jax.random.key(14), b=6, h=8, kvh=4, d=32, bs=8, nbp=3,
+        kv_len=[0, 1, 7, 16, 17, 24])
+    ref = _gather_ref(q, _dequant(kq, ks).astype(q.dtype),
+                      _dequant(vq, vs).astype(q.dtype), tables, kvl)
+    sh = lambda spec, x: jax.device_put(x, NamedSharding(mesh, spec))
+    out = paged_decode_attention_sharded(
+        sh(P(None, "tensor", None), q),
+        sh(P(None, None, "tensor", None), kq),
+        sh(P(None, None, "tensor", None), vq),
+        sh(P(None, None), tables), sh(P(None), kvl),
+        mesh=mesh, interpret=True,
+        k_scale=sh(P(None, "tensor"), ks),
+        v_scale=sh(P(None, "tensor"), vs))
+    live = np.asarray(kvl) > 0
+    np.testing.assert_allclose(np.asarray(out)[live],
+                               np.asarray(ref)[live], rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------ pool write-path quant --
+
+def test_quant_scatter_rows_roundtrip_and_monotone_scale():
+    """quantize-on-write: rows land within one quantization step of their
+    true values; a later larger-amplitude write GROWS the block scale and
+    requantizes the resident content under it (never shrinks it)."""
+    rng = np.random.default_rng(0)
+    pool = jnp.zeros((4, 8, 2, 16), jnp.int8)
+    scale = jnp.zeros((4, 2), jnp.float32)
+    r1 = jnp.asarray(rng.standard_normal((1, 2, 16)), jnp.float32)
+    pool, scale = paged_kv.quant_scatter_rows(
+        pool, scale, jnp.asarray([1]), jnp.asarray([0]), r1)
+    s1 = np.asarray(scale)
+    got1 = np.asarray(pool[1, 0], np.float32) * s1[1][:, None]
+    np.testing.assert_allclose(got1, np.asarray(r1[0]),
+                               atol=float(s1[1].max()) / 2 + 1e-6)
+    # second write, 10x amplitude, same block -> scale grows
+    r2 = 10.0 * jnp.asarray(rng.standard_normal((1, 2, 16)), jnp.float32)
+    pool, scale = paged_kv.quant_scatter_rows(
+        pool, scale, jnp.asarray([1]), jnp.asarray([3]), r2)
+    s2 = np.asarray(scale)
+    assert (s2[1] >= s1[1] - 1e-12).all()
+    # the ORIGINAL row survived the requant within the NEW step size
+    got1b = np.asarray(pool[1, 0], np.float32) * s2[1][:, None]
+    np.testing.assert_allclose(got1b, np.asarray(r1[0]),
+                               atol=float(s2[1].max()) + 1e-6)
+    got2 = np.asarray(pool[1, 3], np.float32) * s2[1][:, None]
+    np.testing.assert_allclose(got2, np.asarray(r2[0]),
+                               atol=float(s2[1].max()) / 2 + 1e-6)
+    # untouched blocks: untouched
+    assert not np.asarray(pool[2]).any() and not s2[2].any()
+
+
+def test_quantized_insert_batch_masks_pad_rows():
+    """Batched prefill insert: pad rows beyond each slot's length are
+    ZEROED before the per-block amax, so garbage in the padded tail can
+    never inflate a final block's scale; live rows round-trip."""
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    d = cfg.dim // cfg.n_heads
+    L, b, t, bs = cfg.n_layers, 2, 16, 8
+    cache = paged_kv.init_paged_cache(cfg, b, 32, bs, 9, quant_kv="int8")
+    rng = np.random.default_rng(1)
+    k_new = jnp.asarray(rng.standard_normal((L, b, t, cfg.n_kv_heads, d)),
+                        jnp.float32)
+    # poison the pad region with huge values: lengths clip them out
+    k_new = k_new.at[:, 0, 5:].set(1e6)
+    v_new = jnp.asarray(rng.standard_normal((L, b, t, cfg.n_kv_heads, d)),
+                        jnp.float32)
+    v_new = v_new.at[:, 0, 5:].set(1e6)
+    blk = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    lengths = jnp.asarray([5, 16], jnp.int32)
+    cache = paged_kv.paged_insert_batch(cache, k_new, v_new, blk, lengths,
+                                        jnp.asarray([0, 1]))
+    assert cache["k"].dtype == jnp.int8
+    ks = np.asarray(cache["k_scale"])
+    # slot 0's scale reflects the LIVE rows only, not the 1e6 poison
+    assert ks[:, 1].max() < 1.0
+    # live rows dequant back within half a step
+    for layer in range(L):
+        s = ks[layer, 1]                       # [KVH]
+        got = (np.asarray(cache["k"][layer, 1, :5], np.float32)
+               * s[None, :, None])
+        np.testing.assert_allclose(
+            got, np.asarray(k_new[layer, 0, :5]),
+            atol=float(s.max()) / 2 + 1e-6)
+
+
+@pytest.mark.slow   # tier-1 time budget; make test-quant runs it
+def test_decode_step_quant_kernel_vs_quant_gather_lockstep():
+    """Full paged_decode_step over a QUANTIZED pool: pallas (fused
+    dequant) vs gather (dequant view) stay in lockstep across decode
+    steps that cross a block boundary. The write path (quantize-on-
+    insert) is shared code, but the read path feeds later layers' hidden
+    states, so inserted k/v — and hence f32 scales — can differ by
+    reduction-order ulps: int8 payloads within one quantization step,
+    scales to float tolerance, lengths bitwise."""
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    cache = paged_kv.init_paged_cache(cfg, 3, 32, 8, 13, quant_kv="int8")
+    tables = jnp.asarray([[1, 2, 3, 4], [0, 0, 0, 0], [5, 6, 7, 8]],
+                         jnp.int32)
+    cache["len"] = jnp.asarray([7, 0, 3], jnp.int32)
+    cache_g = jax.tree.map(jnp.copy, cache)
+    cache_p = jax.tree.map(jnp.copy, cache)
+    tok = jnp.asarray([5, 0, 9], jnp.int32)
+    for _ in range(3):
+        lg, cache_g = paged_kv.paged_decode_step(
+            params, tok, cfg, cache_g, tables, kernel="gather")
+        lp, cache_p = paged_kv.paged_decode_step(
+            params, tok, cfg, cache_p, tables, kernel="pallas")
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lp),
+                                   rtol=1e-4, atol=1e-4)
+        tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(cache_g["len"]),
+                                  np.asarray(cache_p["len"]))
+    for key in ("k", "v"):
+        assert (np.abs(np.asarray(cache_g[key], np.int32)
+                       - np.asarray(cache_p[key], np.int32)) <= 1).all()
+    for key in ("k_scale", "v_scale"):
+        np.testing.assert_allclose(np.asarray(cache_g[key]),
+                                   np.asarray(cache_p[key]),
+                                   rtol=1e-5, atol=1e-8)
+
+
+# --------------------------------------------------- weight quantization --
+
+def test_quantize_weights_roundtrip_bound_and_idempotence_guard():
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    qp = quantize_weights(params, cfg)
+    assert is_weight_quantized(qp) and not is_weight_quantized(params)
+    # per-channel dequant error <= scale/2 (round-to-nearest), per element
+    w = np.asarray(params["layers"]["wq"], np.float32)
+    got = (np.asarray(qp["layers"]["wq_q"], np.float32)
+           * np.asarray(qp["layers"]["wq_s"])[:, None])
+    step = np.asarray(qp["layers"]["wq_s"])[:, None]
+    assert (np.abs(got - w) <= step / 2 + 1e-7).all()
+    # the full-precision names are GONE (structural absence is what makes
+    # exact-parity mode bitwise): no "wq", no "embed"
+    assert "wq" not in qp["layers"] and "embed" not in qp
+    # MoE configs must be refused (resolve_quant downgrades them first)
+    moe = llama.llama_moe_8x(cfg, n_experts=2)
+    with pytest.raises(ValueError, match="MoE"):
+        quantize_weights(params, moe)
+
+
+# ------------------------------------------------------- engine contract --
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _run_engine(params, cfg, quant=None, scheduler=None, max_tokens=8):
+    from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(16,), scheduler=scheduler, quant=quant)
+    prompts = [[5, 6, 7, 8, 5, 6, 7], [9, 10, 11, 9, 10]]
+    reqs = eng.generate(prompts, SamplingParams(max_tokens=max_tokens))
+    return eng, [list(r.generated) for r in reqs]
+
+
+@pytest.mark.slow   # tier-1 time budget; make test-quant runs it
+def test_exact_parity_is_structural_and_bitwise(tiny):
+    """quant=None, QuantConfig() (all 'none') and exact_parity=True all
+    build the SAME program: no quant keys in cache or params, identical
+    tokens, bit-identical pool contents after the same workload."""
+    cfg, params = tiny
+    runs = [_run_engine(params, cfg, quant=q) for q in
+            (None, QuantConfig(), QuantConfig(exact_parity=True),
+             QuantConfig(kv_dtype="int8", weight_dtype="int8",
+                         exact_parity=True))]
+    base_eng, base_toks = runs[0]
+    assert "k_scale" not in base_eng.cache
+    assert "embed_q" not in base_eng.params
+    for eng, toks in runs[1:]:
+        assert toks == base_toks
+        assert "k_scale" not in eng.cache and "embed_q" not in eng.params
+        np.testing.assert_array_equal(np.asarray(base_eng.cache["k"]),
+                                      np.asarray(eng.cache["k"]))
+        np.testing.assert_array_equal(np.asarray(base_eng.cache["v"]),
+                                      np.asarray(eng.cache["v"]))
+        assert eng.quant_downgrades == 0     # parity is a request, not a fallback
+
+
+@pytest.mark.slow   # tier-1 time budget; make test-quant runs it
+def test_quantized_engine_serves_and_stays_close(tiny):
+    """int8 KV + int8 weights through the real engine: requests complete,
+    the pool is stored int8 with live scales, and greedy outputs agree
+    with the unquantized engine on this rig's short streams."""
+    cfg, params = tiny
+    _, base = _run_engine(params, cfg)
+    eng, toks = _run_engine(params, cfg, quant=QuantConfig(
+        kv_dtype="int8", weight_dtype="int8"))
+    assert eng.cache["k"].dtype == jnp.int8
+    assert float(jnp.max(eng.cache["k_scale"])) > 0
+    assert is_weight_quantized(eng.params)
+    assert all(len(t) == 8 for t in toks)
+    agree = sum(a == b for t1, t2 in zip(base, toks)
+                for a, b in zip(t1, t2)) / 16
+    assert agree >= 0.75, (base, toks)
+
+
+@pytest.mark.slow   # tier-1 time budget; make test-quant runs it
+def test_spec_decode_token_identity_under_quant(tiny):
+    """Satellite (b): spec-on vs spec-off under the SAME quant config are
+    token-identical, and verify rounds kept the >=1-token-per-round
+    floor (the verify step's greedy_argmax is stable over the quantized
+    pool)."""
+    from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+
+    cfg, params = tiny
+    q = QuantConfig(kv_dtype="int8", weight_dtype="int8")
+    _, plain = _run_engine(params, cfg, quant=q, max_tokens=10)
+    _, spec = _run_engine(
+        params, cfg, quant=q, max_tokens=10,
+        scheduler=SchedulerConfig(spec_decode=True, spec_k=4))
+    assert spec == plain
+    # the ngram drafter may never match these prompts (zero dispatches);
+    # force a dispatch every round with a deliberately bad drafter so the
+    # verify step actually runs greedy_argmax over the QUANTIZED pool —
+    # identity and the >=1-token-per-round floor must survive rejection
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(16,), quant=q,
+                    scheduler=SchedulerConfig(spec_decode=True, spec_k=4))
+
+    class WrongDrafter:
+        k = 4
+
+        def draft(self, context):
+            return [0]
+
+    eng.spec = WrongDrafter()
+    reqs = eng.generate([[5, 6, 7, 8, 5, 6, 7], [9, 10, 11, 9, 10]],
+                        SamplingParams(max_tokens=10))
+    assert [list(r.generated) for r in reqs] == plain
+    assert eng.sched.spec_slot_rounds > 0
+    assert (eng.sched.spec_committed_tokens
+            >= eng.sched.spec_slot_rounds)   # >= 1 token per verify round
+
+
+def test_scheduler_embedded_quant_reaches_engine(tiny):
+    """SchedulerConfig.quant is honored when the engine gets no explicit
+    quant= argument (the env-less embedding path)."""
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    cfg, params = tiny
+    sched = SchedulerConfig()
+    sched.quant = QuantConfig(kv_dtype="int8")
+    eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                    prefill_buckets=(16,), scheduler=sched)
+    assert eng.cache["k"].dtype == jnp.int8
+    assert eng.quant.tag() == "quant=kv:int8,w:none"
+
+
+# ------------------------------------------------- downgrades, counted --
+
+def test_unsupported_modes_downgrade_counted_never_silent(tiny, monkeypatch):
+    """fp8 on a build without the dtype and int8 weights on MoE both
+    resolve to unquantized WITH (requested, reason) records; the engine
+    folds them into kernel_downgrades and stats, and validate() rejects
+    unknown strings outright."""
+    from kubeflow_tpu.serving import quant as quant_mod
+
+    monkeypatch.setattr(quant_mod, "fp8_unsupported_reason",
+                        lambda platform=None: "no fp8 here")
+    eff, downs = resolve_quant(QuantConfig(kv_dtype="fp8_e4m3"))
+    assert eff == QuantConfig() and len(downs) == 1
+    assert downs[0][0] == "kv_dtype=fp8_e4m3"
+
+    moe = llama.llama_moe_8x(llama.llama_tiny(), n_experts=2)
+    eff, downs = resolve_quant(
+        QuantConfig(kv_dtype="int8", weight_dtype="int8"), cfg=moe)
+    assert eff == QuantConfig(kv_dtype="int8")   # KV half still quantizes
+    assert downs and "MoE" in downs[0][1]
+
+    with pytest.raises(ValueError, match="kv_dtype"):
+        QuantConfig(kv_dtype="int4").validate()
+    with pytest.raises(ValueError, match="weight_dtype"):
+        QuantConfig(weight_dtype="fp8_e4m3").validate()
+
+    # engine-level: the downgrade reaches kernel_downgrades AND the
+    # serving stats, and the engine serves unquantized
+    cfg, params = tiny
+    eng, toks = _run_engine(params, cfg,
+                            quant=QuantConfig(kv_dtype="fp8_e4m3"))
+    assert eng.quant_downgrades == 1
+    assert eng.kernel_downgrades >= 1
+    assert "k_scale" not in eng.cache            # really unquantized
+    assert all(len(t) == 8 for t in toks)
+
+
+def test_stats_expose_active_quant_and_downgrades(tiny):
+    from kubeflow_tpu.serving.jax_model import LLMModel
+
+    cfg, params = tiny
+    model = LLMModel("q", params, cfg, max_batch=2, max_seq=64,
+                     prefill_buckets=(16,),
+                     quant=QuantConfig(kv_dtype="int8", weight_dtype="int8"))
+    model.load()
+    try:
+        st = model.stats()
+        assert st["quant"]["active"] == "quant=kv:int8,w:int8"
+        assert st["quant"]["requested"] == "quant=kv:int8,w:int8"
+        assert st["quant"]["kv_dtype"] == "int8"
+        assert st["quant_downgrades_total"] == 0
+        assert st["kernel_downgrades_total"] == 0
+    finally:
+        model.unload()
+
+
+# ------------------------------------------------------------ depot keys --
+
+def test_depot_quant_configs_never_collide(tmp_path):
+    """The depot fingerprint folds the quant tag: identical HLO under
+    different quant configs gets independent entries, each warm resubmit
+    hits ITS entry, and a corrupt quantized entry heals via a counted
+    local compile (the PR 8 fallback semantics, per quant config)."""
+    from kubeflow_tpu.parallel.depot import (
+        DepotStats, DirectoryDepot, fingerprint, load_or_compile,
+    )
+    from test_depot import _lowered, _run
+
+    tags = ("quant=off", "quant=kv:int8,w:none", "quant=kv:int8,w:int8")
+    txt = _lowered().as_text()
+    keys = {fingerprint(txt, extra=("serving-decode", t)) for t in tags}
+    assert len(keys) == len(tags)
+
+    depot = DirectoryDepot(str(tmp_path))
+    for t in tags:
+        _, outcome = load_or_compile(_lowered(), depot,
+                                     extra=("serving-decode", t))
+        assert outcome == "published"
+    assert len(depot.keys()) == len(tags)
+    for t in tags:                               # per-config warm hits
+        s = DepotStats()
+        _, outcome = load_or_compile(_lowered(), depot,
+                                     extra=("serving-decode", t), stats=s)
+        assert outcome == "hit" and s.snapshot() == {"hits": 1}
+
+    # corrupt ONE config's entry: that config heals locally, the others
+    # keep hitting
+    bad = fingerprint(txt, extra=("serving-decode", tags[2]))
+    depot.put(bad, b"not a pickle", replace=True)
+    s = DepotStats()
+    compiled, outcome = load_or_compile(
+        _lowered(), depot, extra=("serving-decode", tags[2]), stats=s)
+    assert outcome == "published"
+    assert s.get("deserialize_failures") == 1 and s.get("compiles") == 1
+    assert _run(compiled)[0] == _run(_lowered().compile())[0]
+    s2 = DepotStats()
+    _, o2 = load_or_compile(_lowered(), depot,
+                            extra=("serving-decode", tags[2]), stats=s2)
+    assert o2 == "hit"                           # the heal landed
+    s3 = DepotStats()
+    _, o3 = load_or_compile(_lowered(), depot,
+                            extra=("serving-decode", tags[0]), stats=s3)
+    assert o3 == "hit" and s3.get("deserialize_failures") == 0
+
+
+def test_engine_precompile_key_carries_quant_tag(tiny, tmp_path):
+    """Two engines differing ONLY in quant config publish TWO depot
+    entries — a warm claim can never hand the unquantized executable to
+    a quantized replica."""
+    from kubeflow_tpu.parallel.depot import DirectoryDepot
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    cfg, params = tiny
+    depot = DirectoryDepot(str(tmp_path))
+    for q in (None, QuantConfig(kv_dtype="int8")):
+        eng = LLMEngine(params, cfg, max_batch=2, max_seq=64,
+                        prefill_buckets=(16,), quant=q)
+        eng.precompile(depot=depot)
+        del eng
+    assert len(depot.keys()) == 2
+
+
+# ---------------------------------------------------------- env contract --
+
+def test_quant_policy_rides_the_isvc_env_contract():
+    """PredictorSpec.quant -> ISVC controller KFT_QUANT_* stamps (real
+    pod creation through ServingController) -> runtime.quant_from_env
+    gives the SAME QuantConfig back (the PR 6/7 knob contract)."""
+    from kubeflow_tpu.controller.cluster import FakeCluster
+    from kubeflow_tpu.serving.controller import (
+        RuntimeRegistry, ServingController,
+    )
+    from kubeflow_tpu.serving.runtime import quant_from_env
+    from kubeflow_tpu.serving.types import inference_service_from_dict
+
+    pol = QuantConfig(kv_dtype="int8", weight_dtype="int8")
+    isvc = inference_service_from_dict({
+        "name": "llm", "predictor": {
+            "model_format": "llama",
+            "quant": dataclasses.asdict(pol)}})
+    assert isvc.predictor.quant == pol
+
+    cluster = FakeCluster()
+    registry = RuntimeRegistry()
+    from kubeflow_tpu.serving.types import ModelFormat, ServingRuntime
+
+    registry.register(ServingRuntime(
+        name="rt", supported_formats=[ModelFormat("llama")], command=["x"]))
+    ServingController(cluster, registry).apply(isvc)
+    pods = [p for p in cluster.pods.values()
+            if p.labels.get("component") == "predictor"]
+    assert pods
+    env = pods[0].env
+    assert env["KFT_QUANT_KV"] == "int8"
+    assert env["KFT_QUANT_WEIGHTS"] == "int8"
+    assert env["KFT_QUANT_EXACT_PARITY"] == "0"
+    assert quant_from_env(env) == pol
+
+    # parity hatch roundtrips too; nothing set parses to None
+    assert quant_from_env(
+        {"KFT_QUANT_EXACT_PARITY": "1"}) == QuantConfig(exact_parity=True)
+    assert quant_from_env({}) is None
+
+
+def test_scheduler_embedded_quant_stamped_when_no_spec_quant():
+    """A quant config embedded in PredictorSpec.scheduler (and no
+    spec-level quant) still reaches the pod env — mirroring the engine's
+    fallback order."""
+    from kubeflow_tpu.controller.cluster import FakeCluster
+    from kubeflow_tpu.serving.controller import (
+        RuntimeRegistry, ServingController,
+    )
+    from kubeflow_tpu.serving.types import inference_service_from_dict, \
+        ModelFormat, ServingRuntime
+
+    isvc = inference_service_from_dict({
+        "name": "llm2", "predictor": {
+            "model_format": "llama",
+            "scheduler": {"spec_decode": True,
+                          "quant": {"kv_dtype": "int8"}}}})
+    cluster = FakeCluster()
+    registry = RuntimeRegistry()
+    registry.register(ServingRuntime(
+        name="rt", supported_formats=[ModelFormat("llama")], command=["x"]))
+    ServingController(cluster, registry).apply(isvc)
+    env = [p for p in cluster.pods.values()
+           if p.labels.get("component") == "predictor"][0].env
+    assert env["KFT_QUANT_KV"] == "int8"
+    assert env["KFT_QUANT_WEIGHTS"] == "none"
+
+
+# ------------------------------------------------------------- config --
+
+def test_quant_config_tag_and_enabled_semantics():
+    assert QuantConfig().tag() == "quant=off"
+    assert not QuantConfig().enabled
+    assert QuantConfig(exact_parity=True).tag() == "quant=off"
+    assert not QuantConfig(kv_dtype="int8", exact_parity=True).enabled
+    q = QuantConfig(kv_dtype="int8", weight_dtype="int8")
+    assert q.enabled and q.tag() == "quant=kv:int8,w:int8"
+    assert QuantConfig(kv_dtype="fp8_e4m3").tag() == "quant=kv:fp8_e4m3,w:none"
