@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -288,6 +288,42 @@ test-quant:
 			+ ' drift=' + str(q['max_logit_drift']) \
 			+ ' bytes/weight=' + str(b['bytes_per_weight']['quantized']) \
 			+ ' bytes/kv_token=' + str(b['bytes_per_kv_token']['quantized']))"
+
+# disaggregated prefill/decode serving e2e (ISSUE 17): the disagg unit
+# suite (engine hold/export/inject hooks, TCP handoff races — abort,
+# duplicate delivery, eviction pinning, decode-pod death fallback —
+# tier-aware controller/autoscaler, spill-saturation trigger, tier
+# labels on /metrics, TieredRouter bypass), then the disagg bench
+# smoke. Two independent teeth (like test-fleet): bench.py exits
+# nonzero unless a REAL cross-pod KV migration moved blocks between
+# real tier processes, BOTH tier scale-up replicas depot-hit their
+# stage-scoped programs, the migration decomposition landed, and the
+# radix-bypass leg skipped the prefill tier with a counted
+# prefill_bypasses; the JSON contract is then re-checked from the
+# captured file so a silently-vanished counter regresses visibly.
+DISAGG_SMOKE_JSON := /tmp/kft-disagg-smoke.json
+test-disagg:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_disagg.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --disagg-smoke > $(DISAGG_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(DISAGG_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; dis = e['disagg_1p1d']; sc = e['tier_scale_up']; \
+		bp = e['bypass']; hl = e['high_load_p95']; \
+		assert dis['migrated_blocks'] > 0, ('no real migration', d); \
+		assert dis['statuses'].get('migrated', 0) > 0, d; \
+		assert dis['decode_tier']['handoffs_injected_total'] > 0, d; \
+		mdc = dis['migration_decomposition']; \
+		assert mdc['prefill_done_to_first_commit_s'] is not None, d; \
+		assert mdc['export_s'] is not None and mdc['transfer_s'] is not None, d; \
+		assert sc['prefill']['depot_outcome'] == 'hit', ('prefill tier depot miss', sc); \
+		assert sc['decode']['depot_outcome'] == 'hit', ('decode tier depot miss', sc); \
+		assert bp['plan_warm_prompt']['bypass'] is True, ('bypass never fired', bp); \
+		assert bp['router']['prefill_bypasses'] >= 1, bp; \
+		assert hl['ttft_disagg_s'] is not None and hl['itl_disagg_s'] is not None, d; \
+		print('disagg bench OK: migrated_blocks=' + str(dis['migrated_blocks']) \
+			+ ' handoff_p95=' + str(mdc['prefill_done_to_first_commit_s'].get('p95_s')) \
+			+ ' ttft_p95 co=' + str(hl['ttft_colocated_s']) + ' dsg=' + str(hl['ttft_disagg_s']) \
+			+ ' itl_p95 co=' + str(hl['itl_colocated_s']) + ' dsg=' + str(hl['itl_disagg_s']))"
 
 native:
 	$(MAKE) -C native/metadata_store

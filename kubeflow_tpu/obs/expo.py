@@ -38,7 +38,54 @@ HELP: dict[str, str] = {
         "Inter-token latency per generated token (chunk-amortized)",
     "kft_model_request_e2e_seconds":
         "End-to-end request latency (enqueue -> finish)",
+    # disaggregated serving (serving/disagg.py MigrationStats)
+    "kft_disagg_migrations_total":
+        "Completed prefill->decode paged-KV migrations",
+    "kft_disagg_migrated_blocks_total":
+        "Paged-KV blocks moved prefill->decode over the DCN transport",
+    "kft_disagg_migration_failures_total":
+        "Handoffs that fell back to local generation on the prefill pod",
+    "kft_disagg_migration_retries_total":
+        "KV sends retried after a transient no-capacity nack",
+    "kft_disagg_migration_aborts_total":
+        "Handoffs aborted mid-flight (released on both tiers)",
+    "kft_disagg_handoffs_injected_total":
+        "Handoffs admitted into a decode engine's slot map",
+    "kft_disagg_imported_blocks_total":
+        "Paged-KV blocks scattered into a decode pool from handoffs",
+    "kft_disagg_handoff_rejects_total":
+        "Handoffs a decode pod refused (pool full, bad payload)",
+    "kft_disagg_duplicate_deliveries_total":
+        "Duplicate kv frames answered by ack replay (idempotent)",
+    "kft_disagg_releases_total":
+        "Release frames that dropped an injected handoff",
+    "kft_disagg_prefill_bypasses_total":
+        "Requests that skipped the prefill tier on a full radix hit",
+    "kft_disagg_export_seconds_total":
+        "Cumulative device->host KV gather time across migrations",
+    "kft_disagg_transfer_seconds_total":
+        "Cumulative wire+inject time across migrations",
+    "kft_disagg_bytes_sent_total":
+        "Bytes of paged-KV payload sent over the migration transport",
+    "kft_disagg_wire_seconds_total":
+        "Cumulative socket round-trip time of kv frames",
 }
+
+
+def format_labels(**labels) -> Optional[str]:
+    """The ONE inner-label-block builder for /metrics surfaces: sorted
+    ``name="value"`` pairs with empty/None values dropped, or None when
+    nothing survives (so ``model=``/``tier=`` compose identically on
+    every family instead of each renderer hand-rolling f-strings)."""
+    kept = {k: v for k, v in labels.items() if v not in (None, "")}
+    if not kept:
+        return None
+
+    def esc(v) -> str:
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    return ",".join(f'{k}="{esc(v)}"' for k, v in sorted(kept.items()))
 
 Sample = tuple[Optional[str], Union[float, Histogram, dict]]
 Family = tuple[str, str, list[Sample]]

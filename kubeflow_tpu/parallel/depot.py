@@ -116,7 +116,7 @@ def toolchain_versions() -> dict:
 
 
 def fingerprint(hlo_text: str, mesh=None, platform: str = "",
-                extra: tuple = (), stage: Optional[int] = None) -> str:
+                extra: tuple = (), stage=None) -> str:
     """Content-address a compiled program: sha256 over the lowered HLO,
     the mesh/topology it was built for, and the toolchain that built it.
     Everything that changes the machine code must be in here — two
@@ -130,13 +130,15 @@ def fingerprint(hlo_text: str, mesh=None, platform: str = "",
     with a distinguishing prefix so same-HLO different-stage keys can
     never collide; ``mesh`` should then be the STAGE mesh, folding the
     stage-mesh fingerprint (axes, device kinds, size) into the same key.
-    The same scoping later serves disaggregated prefill/decode pools
-    (prefill and decode programs keyed per pool role).
+    The same scoping serves disaggregated prefill/decode pools: ``stage``
+    may be a string role ("serving-prefill", "serving-decode-tier") so
+    each tier's programs key separately. Int stages keep their exact
+    pre-string key bytes.
     """
     h = hashlib.sha256()
     h.update(hlo_text.encode())
     if stage is not None:
-        h.update(f"pipeline_stage={int(stage)}".encode())
+        h.update(f"pipeline_stage={stage}".encode())
     if mesh is not None:
         h.update(json.dumps(sorted(dict(mesh.shape).items())).encode())
         kinds = sorted({getattr(d, "device_kind", "?")
@@ -360,7 +362,7 @@ def _fetch(depot, key: str,
 
 
 def load_or_compile(lowered, depot=None, *, mesh=None, extra: tuple = (),
-                    stage: Optional[int] = None,
+                    stage=None,
                     stats: Optional[DepotStats] = None,
                     wait_s: float = 0.0, poll_s: float = 0.5):
     """The one entry point: fingerprint ``lowered``, fetch the executable

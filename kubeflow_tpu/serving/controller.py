@@ -34,7 +34,8 @@ from kubeflow_tpu.controller.cluster import (
 )
 from kubeflow_tpu.obs.histogram import Histogram
 from kubeflow_tpu.serving.types import (
-    InferenceService, ModelFormat, ServingRuntime,
+    TIER_DEFAULT_SCALE_METRIC, InferenceService, ModelFormat,
+    ServingRuntime, TierSpec,
 )
 
 
@@ -125,8 +126,11 @@ class ServingController:
     def delete(self, namespace: str, name: str) -> None:
         isvc = self.services.pop((namespace, name), None)
         # a later re-created service with the same name starts from its own
-        # spec, not this one's autoscale state or revision cursor
-        self._desired.pop((namespace, name), None)
+        # spec, not this one's autoscale state or revision cursor (tiered
+        # services keep one desired-count entry per tier: 3-tuple keys)
+        for k in [k for k in self._desired
+                  if k[0] == namespace and k[1] == name]:
+            self._desired.pop(k, None)
         self._applied_generation.pop((namespace, name), None)
         if isvc is None:
             return
@@ -180,13 +184,29 @@ class ServingController:
                      if p.labels.get("component") == "predictor")
         # scan bound covers every index the controller can have created:
         # live-count alone would miss a high index exposed by failed-pod
-        # gaps below it (max_replicas bounds autoscaler-created indices)
-        bound = max(want + n_pred, isvc.predictor.max_replicas)
-        for i in range(want, bound):
-            pod = self.cluster.get_pod(
-                isvc.namespace, _pod_name(isvc, "predictor", latest, i))
-            if pod is not None:
-                self.cluster.delete_pod(isvc.namespace, pod.name)
+        # gaps below it (max_replicas bounds autoscaler-created indices).
+        # Disaggregated services scale each tier's pod set independently,
+        # so excess-index deletion runs per tier under the tier-embedded
+        # pod-name component.
+        tiers = self._tiers(isvc)
+        if tiers:
+            for t in tiers:
+                want_t = self._predictor_replicas(isvc, tier=t.name)
+                n_t = sum(1 for p in self._pods(isvc, revision=latest)
+                          if p.labels.get("tier") == t.name)
+                for i in range(want_t, max(want_t + n_t, t.max_replicas)):
+                    pod = self.cluster.get_pod(
+                        isvc.namespace,
+                        _pod_name(isvc, f"predictor-{t.name}", latest, i))
+                    if pod is not None:
+                        self.cluster.delete_pod(isvc.namespace, pod.name)
+        else:
+            bound = max(want + n_pred, isvc.predictor.max_replicas)
+            for i in range(want, bound):
+                pod = self.cluster.get_pod(
+                    isvc.namespace, _pod_name(isvc, "predictor", latest, i))
+                if pod is not None:
+                    self.cluster.delete_pod(isvc.namespace, pod.name)
         self._create_revision_pods(isvc, runtime, latest)
         if self._revision_ready(isvc, latest):
             prev = isvc.status.ready_revision
@@ -204,13 +224,17 @@ class ServingController:
             isvc.status.traffic = {isvc.status.ready_revision: 100}
         return isvc
 
-    def set_scale(self, namespace: str, name: str, replicas: int) -> None:
+    def set_scale(self, namespace: str, name: str, replicas: int,
+                  tier: Optional[str] = None) -> None:
         """Apply an autoscaler decision: the latest revision's predictor pod
         count converges to ``replicas`` on subsequent reconciles (excess pods
-        deleted highest-index-first; missing ones recreated)."""
-        key = (namespace, name)
-        if key not in self.services:
+        deleted highest-index-first; missing ones recreated). For a
+        disaggregated service pass ``tier`` — each tier's pod set scales
+        independently."""
+        if (namespace, name) not in self.services:
             return
+        key = ((namespace, name) if tier is None
+               else (namespace, name, tier))
         self._desired[key] = max(0, int(replicas))
         self.reconcile(namespace, name)
 
@@ -219,7 +243,22 @@ class ServingController:
         for (ns, name) in list(self.services.keys()):
             self.reconcile(ns, name)
 
-    def _predictor_replicas(self, isvc: InferenceService) -> int:
+    @staticmethod
+    def _tiers(isvc: InferenceService) -> list[TierSpec]:
+        return list(getattr(isvc.predictor, "tiers", None) or [])
+
+    def _predictor_replicas(self, isvc: InferenceService,
+                            tier: Optional[str] = None) -> int:
+        tiers = self._tiers(isvc)
+        if tiers:
+            if tier is None:
+                # total across the fleet (readiness / accounting view)
+                return sum(self._predictor_replicas(isvc, tier=t.name)
+                           for t in tiers)
+            spec = next((t for t in tiers if t.name == tier), None)
+            return self._desired.get(
+                (isvc.namespace, isvc.name, tier),
+                spec.min_replicas if spec is not None else 0)
         return self._desired.get((isvc.namespace, isvc.name),
                                  isvc.predictor.min_replicas)
 
@@ -259,66 +298,110 @@ class ServingController:
 
         return allocate_bind(self.cluster) or "0.0.0.0:8080"
 
-    def _create_revision_pods(self, isvc: InferenceService,
-                              runtime: ServingRuntime, revision: int) -> None:
-        predictor_env = {
+    @staticmethod
+    def _sched_env(sp) -> dict:
+        """Step-scheduler knobs ride the same env contract the runtime
+        entrypoint parses (serving/runtime.py)."""
+        return {
+            "KFT_PREFILL_QUOTA": str(sp.prefill_tokens_per_step),
+            "KFT_INTERLEAVE_PREFILL": "1" if sp.interleave_prefill else "0",
+            "KFT_ADAPTIVE_DECODE_CHUNK":
+                "1" if sp.adaptive_decode_chunk else "0",
+            "KFT_RADIX_CACHE": "1" if sp.radix_cache else "0",
+            "KFT_SPEC_DECODE": "1" if sp.spec_decode else "0",
+            "KFT_SPEC_K": str(sp.spec_k),
+            "KFT_SPEC_DRAFTER": sp.spec_drafter,
+        }
+
+    @staticmethod
+    def _quant_env(qp) -> dict:
+        """Quantized serving rides the same contract (serving/runtime.py
+        quant_from_env)."""
+        return {
+            "KFT_QUANT_KV": qp.kv_dtype,
+            "KFT_QUANT_WEIGHTS": qp.weight_dtype,
+            "KFT_QUANT_EXACT_PARITY": "1" if qp.exact_parity else "0",
+        }
+
+    def _predictor_env(self, isvc: InferenceService, runtime: ServingRuntime,
+                       tier: Optional[TierSpec] = None) -> dict:
+        env = {
             **runtime.env, **isvc.predictor.env,
             "KFT_MODEL_NAME": isvc.name,
             "KFT_MODEL_FORMAT": isvc.predictor.model_format.name,
             "KFT_STORAGE_URI": isvc.predictor.storage_uri or "",
             "KFT_COMPILE_CACHE": runtime.compile_cache_dir or "",
         }
-        if isvc.predictor.scheduler is not None:
-            # step-scheduler knobs ride the same env contract the runtime
-            # entrypoint parses (serving/runtime.py)
-            sp = isvc.predictor.scheduler
-            predictor_env.update({
-                "KFT_PREFILL_QUOTA": str(sp.prefill_tokens_per_step),
-                "KFT_INTERLEAVE_PREFILL": "1" if sp.interleave_prefill
-                                          else "0",
-                "KFT_ADAPTIVE_DECODE_CHUNK":
-                    "1" if sp.adaptive_decode_chunk else "0",
-                "KFT_RADIX_CACHE": "1" if sp.radix_cache else "0",
-                "KFT_SPEC_DECODE": "1" if sp.spec_decode else "0",
-                "KFT_SPEC_K": str(sp.spec_k),
-                "KFT_SPEC_DRAFTER": sp.spec_drafter,
-            })
-        # quantized serving rides the same contract (serving/runtime.py
-        # quant_from_env); spec-level quant wins over the scheduler-embedded
-        # one, mirroring the engine's resolution order
-        qp = isvc.predictor.quant
-        if qp is None and isvc.predictor.scheduler is not None:
-            qp = isvc.predictor.scheduler.quant
+        # a tier-level scheduler policy replaces the predictor-level one
+        # wholesale (e.g. a bigger prefill token quota on the prefill tier)
+        sp = ((tier.scheduler if tier is not None else None)
+              or isvc.predictor.scheduler)
+        if sp is not None:
+            env.update(self._sched_env(sp))
+        # spec-level quant wins over the scheduler-embedded one, mirroring
+        # the engine's resolution order; a tier override wins over both
+        qp = ((tier.quant if tier is not None else None)
+              or isvc.predictor.quant
+              or (sp.quant if sp is not None else None))
         if qp is not None:
-            predictor_env.update({
-                "KFT_QUANT_KV": qp.kv_dtype,
-                "KFT_QUANT_WEIGHTS": qp.weight_dtype,
-                "KFT_QUANT_EXACT_PARITY": "1" if qp.exact_parity else "0",
-            })
-        predictor_env.setdefault("KFT_MODEL_DIR", "/mnt/models")
+            env.update(self._quant_env(qp))
+        if tier is not None:
+            env.update(tier.env)
+            env["KFT_TIER"] = tier.name
+        env.setdefault("KFT_MODEL_DIR", "/mnt/models")
+        return env
+
+    def _create_revision_pods(self, isvc: InferenceService,
+                              runtime: ServingRuntime, revision: int) -> None:
         # storage-initializer injection (the reference does this in a pod
         # webhook; here the ISVC controller stamps the init step directly)
         init_cmd = ([sys.executable, "-m", "kubeflow_tpu.serving.runtime",
                      "--init-only"] if isvc.predictor.storage_uri else [])
-        components: list[tuple[str, int, dict, list]] = [
-            ("predictor", self._predictor_replicas(isvc), predictor_env,
-             init_cmd),
-        ]
+        # (pod-name component, component label, tier, replicas, env, init):
+        # tier pods keep the "predictor" component LABEL (the Service
+        # selector and readiness math are tier-blind) but embed the tier in
+        # the pod NAME so each tier's index space scales independently
+        components: list[tuple] = []
+        tiers = self._tiers(isvc)
+        if tiers:
+            for t in tiers:
+                components.append(
+                    (f"predictor-{t.name}", "predictor", t,
+                     self._predictor_replicas(isvc, tier=t.name),
+                     self._predictor_env(isvc, runtime, tier=t), init_cmd))
+        else:
+            components.append(
+                ("predictor", "predictor", None,
+                 self._predictor_replicas(isvc),
+                 self._predictor_env(isvc, runtime), init_cmd))
         if isvc.transformer:
             components.append(
-                ("transformer", isvc.transformer.min_replicas,
+                ("transformer", "transformer", None,
+                 isvc.transformer.min_replicas,
                  dict(isvc.transformer.env), []))
         if isvc.explainer:
             components.append(
-                ("explainer", isvc.explainer.min_replicas,
+                ("explainer", "explainer", None,
+                 isvc.explainer.min_replicas,
                  dict(isvc.explainer.env), []))
-        for comp, replicas, env, init in components:
+        for comp, label, tier, replicas, env, init in components:
             for i in range(replicas):
                 pname = _pod_name(isvc, comp, revision, i)
                 if self.cluster.get_pod(isvc.namespace, pname) is None:
                     pod_env = dict(env)
-                    if comp == "predictor":
+                    if label == "predictor":
                         pod_env["KFT_BIND"] = self._bind_for_pod()
+                        if tier is not None and tier.name == "decode":
+                            # the KV receiver's listener: prefill pods
+                            # stream finished prompts' paged-KV blocks
+                            # here (serving/disagg.KVReceiver). The fixed
+                            # fallback port must NOT collide with the HTTP
+                            # bind sharing the pod's network namespace.
+                            from kubeflow_tpu.controller.cluster import (
+                                allocate_bind)
+                            pod_env["KFT_KV_BIND"] = (
+                                allocate_bind(self.cluster)
+                                or "0.0.0.0:8081")
                         if pod_env.get("KFT_DEPOT_CACHE"):
                             # pod-LOCAL depot cache (pods do not share
                             # node disks on a real cluster): the warm
@@ -326,12 +409,14 @@ class ServingController:
                             # this directory at claim time
                             pod_env["KFT_DEPOT_CACHE"] = os.path.join(
                                 pod_env["KFT_DEPOT_CACHE"], pname)
+                    labels = {"isvc": isvc.name, "component": label,
+                              "revision": str(revision)}
+                    if tier is not None:
+                        labels["tier"] = tier.name
                     pod = Pod(
                         name=pname, namespace=isvc.namespace,
-                        labels={"isvc": isvc.name, "component": comp,
-                                "revision": str(revision)},
-                        env=pod_env, command=list(runtime.command),
-                        init_command=init)
+                        labels=labels, env=pod_env,
+                        command=list(runtime.command), init_command=init)
                     # Deployment-style admission: serving pods have no gang
                     # barrier — start them the moment they exist (the
                     # production path; tests no longer play kubelet here)
@@ -383,9 +468,15 @@ class ServingTicker:
 
     def __init__(self, controller: ServingController,
                  autoscaler: Optional["Autoscaler"] = None,
-                 concurrency_of=None, signals_of=None, lock=None):
+                 concurrency_of=None, signals_of=None, lock=None,
+                 router_of=None):
         self.controller = controller
         self.autoscaler = autoscaler
+        # router_of(isvc) -> the FleetRouter (or TieredRouter) fronting
+        # this service, or None. Wired by the operator that owns the data
+        # plane; the ticker feeds each tick's cumulative spill_saturated
+        # count into the Autoscaler as a saturation scale-up trigger.
+        self.router_of = router_of
         self.concurrency_of = concurrency_of or self._probe_concurrency
         # a caller that injected ONLY a concurrency source keeps it: the
         # signal probe must not silently outrank an explicit injection
@@ -486,8 +577,38 @@ class ServingTicker:
                     continue
             if sched:
                 sched["replica"] = pod.name
+                if pod.labels.get("tier"):
+                    # tier-attributed signal: the per-tier autoscale loop
+                    # partitions on this key
+                    sched["tier"] = pod.labels["tier"]
                 out.append(sched)
         return out
+
+    def _spill_of(self, isvc: InferenceService):
+        """Cumulative ``spill_saturated`` router count(s) for a service:
+        a float for a flat fleet, a {tier: float} dict for a
+        ``TieredRouter``, None when no router is wired (or it errors —
+        a data-plane hiccup must not stall the control loop)."""
+        if self.router_of is None:
+            return None
+        try:
+            router = self.router_of(isvc)
+        except Exception:
+            return None
+        if router is None:
+            return None
+
+        def count(r):
+            try:
+                v = r.snapshot().get("spill_saturated")
+            except Exception:
+                return None
+            return None if v is None else float(v)
+
+        if hasattr(router, "router_for"):        # TieredRouter
+            return {t: count(router.router_for(t))
+                    for t in ("prefill", "decode")}
+        return count(router)
 
     def tick(self) -> None:
         for (ns, name) in list(self.controller.services.keys()):
@@ -510,12 +631,35 @@ class ServingTicker:
                        else self.signals_of(isvc))      # unlocked HTTP
             concurrency = (self.concurrency_of(isvc)
                            if not signals else None)
-            with self.lock:
-                desired = self.autoscaler.scale(
-                    isvc, concurrency, signals=signals,
-                    current=self.controller._predictor_replicas(isvc))
-                if desired != self.controller._predictor_replicas(isvc):
-                    self.controller.set_scale(ns, name, desired)
+            spill = self._spill_of(isvc)                # unlocked HTTP-free
+            tiers = list(isvc.predictor.tiers or [])
+            if not tiers:
+                with self.lock:
+                    desired = self.autoscaler.scale(
+                        isvc, concurrency, signals=signals,
+                        current=self.controller._predictor_replicas(isvc),
+                        spill_saturated=(spill if not isinstance(spill, dict)
+                                         else None))
+                    if desired != self.controller._predictor_replicas(isvc):
+                        self.controller.set_scale(ns, name, desired)
+                continue
+            # disaggregated: one independent scaling decision per tier on
+            # its own signal partition (signals a test injects without a
+            # tier tag count toward every tier)
+            for t in tiers:
+                sig_t = [s for s in signals
+                         if s.get("tier", t.name) == t.name]
+                spill_t = (spill.get(t.name)
+                           if isinstance(spill, dict) else spill)
+                with self.lock:
+                    cur = self.controller._predictor_replicas(
+                        isvc, tier=t.name)
+                    desired = self.autoscaler.scale(
+                        isvc, concurrency, signals=sig_t, current=cur,
+                        tier=t, spill_saturated=spill_t)
+                    if desired != cur:
+                        self.controller.set_scale(ns, name, desired,
+                                                  tier=t.name)
 
     def _tick_canary(self, ns: str, name: str,
                      isvc: InferenceService) -> None:
@@ -583,12 +727,20 @@ class Autoscaler:
     from the second window (its grace already elapsed)."""
 
     def __init__(self, idle_grace_seconds: float = 30.0,
-                 backlog_tokens_per_replica: int = 0):
+                 backlog_tokens_per_replica: int = 0,
+                 spill_saturation_ticks: int = 2):
         self.idle_grace = idle_grace_seconds
         self.backlog_tokens_per_replica = int(backlog_tokens_per_replica)
-        self._last_busy: dict[tuple[str, str], float] = {}
-        self._low_since: dict[tuple[str, str], float] = {}
-        self._applied: dict[tuple[str, str], int] = {}
+        # router-saturation trigger: the cumulative spill_saturated count
+        # must RISE across this many consecutive scale() calls before one
+        # replica is added — a single burst that the bounded-load spill
+        # already absorbed is not a capacity problem
+        self.spill_saturation_ticks = max(1, int(spill_saturation_ticks))
+        self._last_busy: dict[tuple, float] = {}
+        self._low_since: dict[tuple, float] = {}
+        self._applied: dict[tuple, int] = {}
+        self._spill_last: dict[tuple, float] = {}
+        self._spill_rising: dict[tuple, int] = {}
 
     def wake(self, namespace: str, name: str,
              now: Optional[float] = None) -> None:
@@ -602,29 +754,72 @@ class Autoscaler:
               concurrency: Optional[float] = None,
               now: Optional[float] = None, *,
               signals: Optional[list] = None,
-              current: Optional[int] = None) -> int:
+              current: Optional[int] = None,
+              tier: Optional[TierSpec] = None,
+              spill_saturated: Optional[float] = None) -> int:
         now = time.time() if now is None else now
-        key = (isvc.namespace, isvc.name)
+        key = ((isvc.namespace, isvc.name) if tier is None
+               else (isvc.namespace, isvc.name, tier.name))
         p = isvc.predictor
+        min_r = p.min_replicas if tier is None else tier.min_replicas
+        max_r = p.max_replicas if tier is None else tier.max_replicas
+        target = p.scale_target if tier is None else (
+            tier.scale_target or p.scale_target)
+        metric = ("occupancy_slots" if tier is None
+                  else (tier.scale_metric
+                        or TIER_DEFAULT_SCALE_METRIC.get(
+                            tier.name, "occupancy_slots")))
         if signals:
-            slots = sum(float(s.get("occupancy_slots", 0)) for s in signals)
-            queued = sum(float(s.get("queue_depth", 0)) for s in signals)
-            backlog = sum(float(s.get("token_backlog", 0)) for s in signals)
-            demand = slots + queued
-            desired = math.ceil(demand / max(1, p.scale_target))
-            if self.backlog_tokens_per_replica > 0:
-                desired = max(desired, math.ceil(
-                    backlog / self.backlog_tokens_per_replica))
-            busy = demand > 0 or backlog > 0
+            if metric == "token_backlog":
+                # prefill-tier shape: demand is the prompt tokens not yet
+                # scheduled; scale_target is TOKENS per replica here
+                backlog = sum(float(s.get("token_backlog", 0))
+                              for s in signals)
+                desired = math.ceil(backlog / max(1, target))
+                busy = backlog > 0
+            else:
+                slots = sum(float(s.get(metric, 0)) for s in signals)
+                queued = sum(float(s.get("queue_depth", 0))
+                             for s in signals)
+                backlog = sum(float(s.get("token_backlog", 0))
+                              for s in signals)
+                demand = slots + queued
+                desired = math.ceil(demand / max(1, target))
+                if self.backlog_tokens_per_replica > 0:
+                    desired = max(desired, math.ceil(
+                        backlog / self.backlog_tokens_per_replica))
+                busy = demand > 0 or backlog > 0
         else:
             concurrency = concurrency or 0.0
-            desired = math.ceil(concurrency / max(1, p.scale_target))
+            desired = math.ceil(concurrency / max(1, target))
             busy = concurrency > 0
+        cur = current if current is not None else self._applied.get(key)
+        if spill_saturated is not None:
+            # router-saturation trigger (FleetRouter.spill_saturated is a
+            # cumulative count of picks where EVERY replica was over the
+            # bounded-load threshold): sustained growth means the whole
+            # fleet is saturated — per-replica signals alone can plateau
+            # at exactly scale_target and never cross the demand line
+            last = self._spill_last.get(key)
+            self._spill_last[key] = float(spill_saturated)
+            if last is not None and spill_saturated > last:
+                self._spill_rising[key] = self._spill_rising.get(key, 0) + 1
+            else:
+                self._spill_rising[key] = 0
+            if self._spill_rising[key] >= self.spill_saturation_ticks:
+                desired = max(desired,
+                              (cur if cur is not None else desired) + 1)
+                busy = True
+                # one replica per sustained-saturation window: the next
+                # add needs a fresh run of rising ticks
+                self._spill_rising[key] = 0
         if busy:
             self._last_busy[key] = now
         scaled_to_zero = False
-        if p.min_replicas == 0:
-            idle_since = self._last_busy.get(key, 0.0)
+        if min_r == 0:
+            # wake() marks the 2-tuple service key; a tier consults both
+            idle_since = max(self._last_busy.get(key, 0.0),
+                             self._last_busy.get(key[:2], 0.0))
             if (not busy and now - idle_since > self.idle_grace
                     and not _mid_canary(isvc)):
                 # a live canary split is never collapsed to zero — the
@@ -632,8 +827,7 @@ class Autoscaler:
                 desired, scaled_to_zero = 0, True
             else:
                 desired = max(1, desired)
-        desired = max(p.min_replicas, min(p.max_replicas, desired))
-        cur = current if current is not None else self._applied.get(key)
+        desired = max(min_r, min(max_r, desired))
         if cur is not None and desired < cur and not scaled_to_zero:
             if _mid_canary(isvc):
                 # never shrink mid-canary; restart the low-signal clock

@@ -174,7 +174,7 @@ class LLMModel(Model):
                  compile_cache_dir: Optional[str] = None,
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
                  tokenizer=None, request_timeout: float = 600.0,
-                 mesh=None, scheduler=None, quant=None):
+                 mesh=None, scheduler=None, quant=None, tier: str = ""):
         super().__init__(name)
         self._params = params
         self.cfg = cfg
@@ -188,6 +188,13 @@ class LLMModel(Model):
         self.prefill_buckets = prefill_buckets
         self.tokenizer = tokenizer
         self.request_timeout = request_timeout
+        # disaggregated serving (serving/disagg.py): which tier this
+        # replica plays ("" = co-located). The tier scopes the depot key
+        # precompile() uses, labels the /metrics + stats surfaces, and —
+        # when the runtime attaches a TierRuntime — carries the
+        # KV-migration glue the server's /disagg routes dispatch to.
+        self.tier = str(tier or "")
+        self.disagg = None            # TierRuntime, attached by runtime.py
         self.engine: Optional[LLMEngine] = None
         self._wake = threading.Condition()
         self._thread: Optional[threading.Thread] = None
@@ -249,7 +256,8 @@ class LLMModel(Model):
         if os.environ.get("KFT_DEPOT"):
             self._depot_stats = DepotStats()
             depot = depot_from_env(stats=self._depot_stats)
-            self.engine.precompile(depot=depot, stats=self._depot_stats)
+            self.engine.precompile(depot=depot, stats=self._depot_stats,
+                                   tier=self.tier)
             self.precompile_seconds = round(time.perf_counter() - t1, 3)
         self._shutdown = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -265,6 +273,12 @@ class LLMModel(Model):
             self._thread.join(timeout=5)
         self.engine = None
         self.ready = False
+
+    def kick(self) -> None:
+        """Wake the scheduler thread (a disagg control op was queued on
+        the engine, or work arrived by a path that didn't notify)."""
+        with self._wake:
+            self._wake.notify_all()
 
     def _loop(self) -> None:
         while not self._shutdown:
@@ -348,6 +362,12 @@ class LLMModel(Model):
             "request_histograms": {
                 k: h.snapshot() for k, h in eng.request_hists.items()},
         }
+        if self.tier:
+            # tier attribution (disagg): stats consumers and the /metrics
+            # renderer key per-tier latency off this field
+            out["tier"] = self.tier
+        if self.disagg is not None:
+            out["disagg"] = self.disagg.snapshot()
         if self.load_seconds is not None:
             # replica-add decomposition (fleet bench): model/engine build
             # vs decode-program acquisition, with the depot outcome and

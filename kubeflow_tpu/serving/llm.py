@@ -30,7 +30,13 @@ import numpy as np
 
 from kubeflow_tpu.models import llama
 from kubeflow_tpu.obs import trace as obs_trace
-from kubeflow_tpu.obs.histogram import Histogram
+from kubeflow_tpu.obs.histogram import Histogram, log_buckets
+
+# Request-latency buckets at factor 2**0.25 (~19% relative error) instead
+# of the default factor-2: serving A/B comparisons (canary gate, the
+# co-located-vs-disagg bench legs) discriminate distributions well inside
+# one octave of each other, which factor-2 buckets collapse into a tie.
+_REQ_LAT_BUCKETS = log_buckets(0.001, 64.0, factor=2 ** 0.25)
 from kubeflow_tpu.serving.scheduler import (
     QuantConfig, SchedulerConfig, StepScheduler, ceil_pow2,
 )
@@ -91,6 +97,10 @@ class GenRequest:
     # then reads as a clean "stop" finish, not a client disconnect
     stop_matched: bool = False
     slot: Optional[int] = None
+    # disaggregated prefill tier (serving/disagg.py): park the request
+    # after prefill + first token instead of decoding — KV stays resident
+    # (blocks refcount-pinned) until export_held_kv/release_held
+    hold_after_prefill: bool = False
     # observability: the request's trace context ((trace_id, span_id) of
     # its queue span — decode/prefill spans attribute to it), wall-clock
     # latency marks (enqueue/first-token/last-commit/done) feeding the
@@ -99,6 +109,9 @@ class GenRequest:
     trace: Optional[tuple] = None
     t_enqueue: float = 0.0
     t_first_token: float = 0.0
+    # first DECODE commit (token #2) — on a disagg decode pod this bounds
+    # the migration decomposition: prefill-complete -> first decode commit
+    t_second_token: float = 0.0
     t_last_commit: float = 0.0
     t_done: float = 0.0
     spans: dict = dataclasses.field(default_factory=dict)
@@ -320,6 +333,15 @@ class LLMEngine:
         self._active: dict[int, GenRequest] = {}     # slot -> request
         self._waiting: list[GenRequest] = []
         self._aborted: set[int] = set()              # request ids to retire
+        # disaggregated prefill tier: slot -> request parked after prefill
+        # (hold_after_prefill) awaiting KV export/migration; their blocks
+        # stay refcount-pinned so eviction can never reach them
+        self._held: dict[int, GenRequest] = {}
+        # control ops (export/inject/release from disagg glue threads):
+        # the decode dispatch donates the cache buffers, so ALL cache
+        # mutation must run on the step thread — ops queue here and drain
+        # at the top of step()
+        self._ctl: list = []
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._tokens = np.zeros((max_batch,), np.int32)   # next input token
@@ -352,8 +374,9 @@ class LLMEngine:
         # process collector, plus the three request-latency histograms
         # /metrics serves as kft_model_request_{ttft,itl,e2e}_seconds
         self.obs = obs or obs_trace.collector()
-        self.request_hists = {"ttft": Histogram(), "itl": Histogram(),
-                              "e2e": Histogram()}
+        self.request_hists = {"ttft": Histogram(_REQ_LAT_BUCKETS),
+                              "itl": Histogram(_REQ_LAT_BUCKETS),
+                              "e2e": Histogram(_REQ_LAT_BUCKETS)}
         self.paged.prefix_cache = self.sched.cfg.radix_cache
         # in-flight chunked prefills, slot -> state (insertion order = FIFO)
         self._chunked: dict[int, _ChunkedPrefill] = {}
@@ -407,6 +430,10 @@ class LLMEngine:
         # static config differs (non-greedy batch, adaptive chunk trim)
         # fall back to the jitted path above.
         self._compiled_decode = None
+        # prefill-tier twin (precompile(tier="prefill")): the AOT chunked-
+        # prefill program — the prefill pod's steady-state program under
+        # its own depot key scope
+        self._compiled_prefill_chunk = None
         self.depot_outcome: Optional[str] = None
         # speculative verify: greedy target chain + chosen-token logprobs
         # for a [B, S] candidate batch in ONE dispatch. S is pow2-padded
@@ -478,7 +505,8 @@ class LLMEngine:
 
     # ---------------- public API ----------------
 
-    def precompile(self, depot=None, stats=None, wait_s: float = 0.0) -> str:
+    def precompile(self, depot=None, stats=None, wait_s: float = 0.0,
+                   tier: str = "") -> str:
         """Split the decode compile from request #1 (the serving analogue
         of ``Trainer.precompile``): AOT-lower the steady-state decode
         program — full ``decode_chunk``, greedy batch, the engine's
@@ -497,6 +525,24 @@ class LLMEngine:
         from kubeflow_tpu.parallel.depot import load_or_compile
 
         b = self.max_batch
+        if tier == "prefill":
+            # the prefill tier's steady-state program is the CHUNKED
+            # prefill (long prompts stream through it; bucketed admission
+            # stays lazily jitted) — keyed under its own stage scope, the
+            # PR 11 per-stage scheme reused for the two tier programs of
+            # one model: a scale-up prefill replica hits THIS entry and a
+            # decode replica hits the decode entry, never each other's
+            lowered = self._prefill_chunk.lower(
+                self.params, jnp.zeros((1, self._chunk_width), jnp.int32),
+                self.cache,
+                jnp.zeros((b, self.paged.max_blocks_per_seq), jnp.int32),
+                jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            self._compiled_prefill_chunk, outcome = load_or_compile(
+                lowered, depot, mesh=self.mesh, stats=stats, wait_s=wait_s,
+                stage="serving-prefill",
+                extra=(f"chunk={self._chunk_width}", self.quant.tag()))
+            self.depot_outcome = outcome
+            return outcome
         lowered = self._decode.lower(
             self.params, jnp.zeros((b,), jnp.int32), self.cache,
             jnp.zeros((b, self.paged.max_blocks_per_seq), jnp.int32),
@@ -510,6 +556,7 @@ class LLMEngine:
         # therefore lands the per-config executable automatically
         self._compiled_decode, outcome = load_or_compile(
             lowered, depot, mesh=self.mesh, stats=stats, wait_s=wait_s,
+            stage=("serving-decode-tier" if tier == "decode" else None),
             extra=("serving-decode", self.quant.tag()))
         self.depot_outcome = outcome
         return outcome
@@ -541,15 +588,19 @@ class LLMEngine:
 
     def add_request(self, prompt: Sequence[int],
                     sampling: Optional[SamplingParams] = None,
-                    trace: Optional[str] = None) -> GenRequest:
+                    trace: Optional[str] = None,
+                    hold_after_prefill: bool = False) -> GenRequest:
         """``trace``: an incoming W3C traceparent (router/server span) —
         the request's queue span roots under it, so the full
         router -> server -> queue -> prefill -> decode chain shares one
-        trace id across processes."""
+        trace id across processes. ``hold_after_prefill``: disaggregated
+        prefill tier — park after prefill + first token for KV export
+        instead of decoding."""
         sampling = sampling or SamplingParams()
         self.validate_prompt(prompt, sampling)
         req = GenRequest(id=next(self._ids), prompt=list(map(int, prompt)),
-                         sampling=sampling)
+                         sampling=sampling,
+                         hold_after_prefill=bool(hold_after_prefill))
         req.t_enqueue = time.time()
         qspan = self.obs.start(
             "request.queue", parent=trace,
@@ -579,6 +630,105 @@ class LLMEngine:
         with self._lock:
             self._waiting = [r for r in self._waiting if r.id not in ids]
             self._aborted.update(ids)
+
+    # ------------- disaggregated prefill/decode (serving/disagg.py) -------
+    # Engine-thread-only: these mutate the cache (whose buffers the decode
+    # dispatch donates), so cross-thread callers MUST route through
+    # submit_ctl. Single-threaded tests may call them directly between
+    # step()s.
+
+    def held_requests(self) -> list[GenRequest]:
+        return list(self._held.values())
+
+    def export_held_kv(self, req: GenRequest) -> Optional[dict]:
+        """Package a held request's PROMPT blocks for migration: gather
+        the first ``blocks_for(len(prompt))`` blocks of its reservation
+        (the empty generation-budget tail never travels) to host numpy,
+        plus everything the decode tier needs to resume — prompt, the
+        prefill-sampled token #1 and its logprob, sampling params and the
+        original enqueue time (so the decode pod's latency marks stay on
+        the request's true clock). Returns None when the request was
+        aborted/released before export (the caller drops the migration)."""
+        from kubeflow_tpu.serving.paged_kv import (
+            blocks_for, gather_kv_blocks,
+        )
+
+        slot = req.slot
+        if slot is None or self._held.get(slot) is not req:
+            return None
+        bs = self.paged.block_size
+        n = blocks_for(len(req.prompt), bs)
+        ids = self.paged.slot_blocks(slot)[:n]
+        return {
+            "prompt": list(req.prompt),
+            "first_token": int(req.generated[0]),
+            "first_lp": float(req.logprobs[0]),
+            "sampling": dataclasses.asdict(req.sampling),
+            "t_enqueue": req.t_enqueue,
+            "t_prefill_done": req.t_first_token,
+            "block_size": bs,
+            "n_blocks": n,
+            "blocks": gather_kv_blocks(self.cache, ids),
+        }
+
+    def release_held(self, req: GenRequest) -> bool:
+        """Drop a held request's slot + block reservation — the prefill
+        side of the ownership edge, called after the decode tier acked
+        the handoff (ownership moved) OR on a failed/aborted migration
+        (ownership stays dropped; radix-published blocks remain cached
+        and evictable, so a local re-prefill is one cheap chunk)."""
+        slot = req.slot
+        if slot is None or self._held.get(slot) is not req:
+            return False
+        del self._held[slot]
+        req.done = True
+        self.paged.release(slot)
+        self._free.append(slot)
+        return True
+
+    def inject_request(self, prompt: Sequence[int],
+                       sampling: SamplingParams, *, first_token: int,
+                       first_lp: float, blocks: dict, n_blocks: int,
+                       t_enqueue: float = 0.0) -> Optional[GenRequest]:
+        """Decode-tier admission of a migrated prefill: reserve a slot,
+        scatter the imported prompt blocks into the pool (radix-shared
+        prefix blocks are skipped — the pool already holds them), set the
+        slot length and commit token #1 exactly like a local admission.
+        The reservation refcounts every imported block BEFORE the scatter,
+        so concurrent eviction pressure can never reclaim a mid-handoff
+        block. Returns None when no slot or pool capacity is available
+        (the caller nacks the handoff and the prefill pod falls back to
+        local re-prefill)."""
+        from kubeflow_tpu.serving.paged_kv import scatter_kv_blocks
+
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+        L = len(prompt)
+        n_shared = self.paged.reserve(
+            slot, L, sampling.max_tokens, min_blocks=n_blocks,
+            prompt=prompt, defer_publish=True)
+        if n_shared is None:
+            with self._lock:
+                self._free.append(slot)
+            return None
+        req = GenRequest(id=next(self._ids),
+                         prompt=list(map(int, prompt)), sampling=sampling)
+        req.t_enqueue = t_enqueue or time.time()
+        ids = self.paged.slot_blocks(slot)[:n_blocks]
+        if n_shared < n_blocks:
+            sub = {k: v[:, n_shared:n_blocks]
+                   for k, v in blocks.items()}
+            self.cache = scatter_kv_blocks(self.cache, ids[n_shared:], sub)
+        self.cache = self._set_len(self.cache, jnp.int32(L),
+                                   jnp.int32(slot))
+        # publish the imported full prompt blocks to THIS pool's radix
+        # tree: a later fully-shared-prefix request can then bypass the
+        # prefill tier entirely and admit here at radix-hit cost
+        self.paged.publish_prompt_blocks(slot, prompt, L)
+        self._post_admit(req, slot, int(first_token), float(first_lp))
+        return req
 
     # ---------------- observability hooks ----------------
 
@@ -621,6 +771,10 @@ class LLMEngine:
             if req.t_enqueue:
                 self.request_hists["ttft"].observe(now - req.t_enqueue)
             n_new -= 1
+        elif req.t_second_token == 0.0:
+            # first commit past token #1 = the first DECODE commit; on a
+            # disagg decode pod this closes the migration decomposition
+            req.t_second_token = now
         if n_new > 0 and req.t_last_commit:
             gap = max(0.0, now - req.t_last_commit) / n_new
             itl = self.request_hists["itl"]
@@ -630,7 +784,23 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._waiting or self._active or self._chunked)
+            return bool(self._waiting or self._active or self._chunked
+                        or self._ctl)
+
+    def submit_ctl(self, fn) -> None:
+        """Queue ``fn`` to run on the step thread at the top of the next
+        step() — the only safe way for another thread to touch engine/
+        cache state (the decode dispatch donates the cache buffers).
+        Callers needing the result wrap ``fn`` to capture it and wake the
+        model loop (serving/disagg.py TierRuntime.run_on_engine)."""
+        with self._lock:
+            self._ctl.append(fn)
+
+    def _drain_ctl(self) -> None:
+        with self._lock:
+            ops, self._ctl = self._ctl, []
+        for fn in ops:
+            fn()
 
     def scheduler_stats(self) -> dict:
         """Scheduler counters + gauges for /metrics (occupancy, queue
@@ -665,12 +835,21 @@ class LLMEngine:
         host transfer + bookkeeping; results therefore lag one chunk.
         Returns requests that finished this step."""
         self.sched.note_step()
+        self._drain_ctl()
         with self._lock:
             aborted, self._aborted = self._aborted, set()
         if aborted:
             for slot, req in list(self._active.items()):
                 if req.id in aborted:
                     del self._active[slot]
+                    self.paged.release(slot)
+                    self._free.append(slot)
+            # a held prefill whose request aborted mid-migration releases
+            # its side HERE — the prefill half of the "releases on both
+            # sides" contract (the decode half is disagg release/collect)
+            for slot, req in list(self._held.items()):
+                if req.id in aborted:
+                    del self._held[slot]
                     self.paged.release(slot)
                     self._free.append(slot)
             # abort of a request whose chunked prefill is mid-flight is
@@ -1020,7 +1199,8 @@ class LLMEngine:
         piece = np.zeros((1, W), np.int32)
         part = req.prompt[st.offset:st.offset + W]
         piece[0, :len(part)] = part
-        st.x_last, self.cache = self._prefill_chunk(
+        chunk_fn = self._compiled_prefill_chunk or self._prefill_chunk
+        st.x_last, self.cache = chunk_fn(
             self.params, jnp.asarray(piece), self.cache, st.tables,
             jnp.int32(slot), jnp.int32(st.offset), jnp.int32(L),
             jnp.int32(st.share_len))
@@ -1220,3 +1400,11 @@ class LLMEngine:
         self._note_request_latency(req, 1)       # TTFT closes here
         if done:
             self._retire(req, slot)
+        elif req.hold_after_prefill:
+            # disagg prefill tier: the prefill is complete and token #1
+            # sampled — park the request for export_held_kv instead of
+            # decoding. The slot stays allocated and its blocks stay
+            # refcount-pinned (PREFILL_OWNED in the handoff state machine)
+            # until release_held transfers or drops ownership.
+            del self._active[slot]
+            self._held[slot] = req

@@ -35,6 +35,7 @@ Decode attention has two execution paths, selected by
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -393,6 +394,72 @@ class PagedKV:
 
     def slot_blocks(self, slot: int) -> list[int]:
         return list(self._slot_blocks.get(slot, []))
+
+
+# -------------------------------------------------- KV block migration ----
+# Device<->host movers for disaggregated serving (serving/disagg.py): a
+# finished prefill's pool blocks leave the prefill pod as host numpy and
+# land in a (different) decode pod's pool. Both sides pad the id list to
+# the next power of two so the compile count stays log-bounded in blocks
+# per request; pad ids are block 0 — the scratch block whose content is
+# garbage by contract — so the extra gather rows are discarded on the
+# host and the extra scatter writes land where writes are already allowed.
+
+def _pool_keys(cache: dict) -> tuple:
+    return tuple(k for k in ("k", "v", "k_scale", "v_scale") if k in cache)
+
+
+@functools.partial(jax.jit, static_argnames=("keys",))
+def _gather_pools(cache, idx, keys):
+    return {key: jnp.take(cache[key], idx, axis=1) for key in keys}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("keys",))
+def _scatter_pools(cache, idx, blocks, keys):
+    new = dict(cache)
+    for key in keys:
+        new[key] = cache[key].at[:, idx].set(
+            blocks[key].astype(cache[key].dtype))
+    return new
+
+
+def _pad_pow2(ids) -> tuple:
+    n = len(ids)
+    m = 1 << max(0, (n - 1).bit_length())
+    idx = np.zeros((max(1, m),), np.int32)
+    idx[:n] = ids
+    return idx, n
+
+
+def gather_kv_blocks(cache: dict, ids) -> dict:
+    """Fetch pool blocks ``ids`` to host numpy — [L, n, bs, KV, D] per
+    pool (plus [L, n, KV] scale tables when the pool is quantized: the
+    payload migrates at the pool's stored bytes, int8 KV ships as
+    int8)."""
+    idx, n = _pad_pow2(ids)
+    keys = _pool_keys(cache)
+    out = jax.device_get(_gather_pools(cache, jnp.asarray(idx), keys))
+    return {key: np.asarray(v)[:, :n] for key, v in out.items()}
+
+
+def scatter_kv_blocks(cache: dict, ids, blocks: dict) -> dict:
+    """Write migrated block payloads into pool blocks ``ids`` and return
+    the new cache dict (pools are donated — no full-pool copy survives).
+    ``blocks`` is ``gather_kv_blocks`` output, possibly sliced on axis 1
+    to drop radix-shared prefix blocks the destination already holds."""
+    if not len(ids):
+        return cache
+    idx, n = _pad_pow2(ids)
+    keys = tuple(k for k in _pool_keys(cache) if k in blocks)
+    pay = {}
+    for key in keys:
+        b = np.asarray(blocks[key])
+        if len(idx) > n:
+            pad = np.zeros((b.shape[0], len(idx) - n) + b.shape[2:],
+                           b.dtype)
+            b = np.concatenate([b, pad], axis=1)
+        pay[key] = b
+    return _scatter_pools(cache, jnp.asarray(idx), pay, keys)
 
 
 # ------------------------------------------------------------ jitted bodies

@@ -280,6 +280,88 @@ class FleetRouter:
             }
 
 
+class TieredRouter:
+    """Tier-aware routing for a disaggregated fleet (serving/disagg.py):
+    admission lands on the PREFILL tier and the stream moves to a
+    prefix-affine DECODE replica at handoff. Both tiers are full
+    ``FleetRouter``s — the decode pick is prefix-affine so handoffs of
+    shared-prefix tenants pile onto one pool (which is what makes the
+    bypass rule fire), and the prefill pick is prefix-affine so repeat
+    prefixes re-prefill against a warm radix tree.
+
+    Bypass rule: when ``cached_blocks_of(decode_replica, prompt)``
+    reports every FULL prompt block already radix-resident on the affine
+    decode replica, the request skips the prefill tier entirely
+    (``plan["bypass"]``) and admits on the decode replica as a normal
+    request — the fully-shared prefix costs one chunk there, strictly
+    cheaper than prefill + migration. Counted as ``prefill_bypasses``.
+    """
+
+    def __init__(self, *, block_size: int = 16,
+                 spill_queue_depth: int = 4, vnodes: int = 64,
+                 load_of: Optional[Callable] = None, seed: int = 0,
+                 cached_blocks_of: Optional[Callable] = None):
+        self.block_size = int(block_size)
+        self.prefill = FleetRouter(
+            block_size=block_size, spill_queue_depth=spill_queue_depth,
+            vnodes=vnodes, load_of=load_of, seed=seed)
+        self.decode = FleetRouter(
+            block_size=block_size, spill_queue_depth=spill_queue_depth,
+            vnodes=vnodes, load_of=load_of, seed=seed)
+        self.cached_blocks_of = cached_blocks_of
+        self._lock = threading.Lock()
+        self.plans = 0
+        self.handoffs_planned = 0
+        self.prefill_bypasses = 0
+
+    def router_for(self, tier: str) -> FleetRouter:
+        if tier == "prefill":
+            return self.prefill
+        if tier == "decode":
+            return self.decode
+        raise ValueError(f"tier={tier!r} (want prefill|decode)")
+
+    def add_replica(self, tier: str, name: str, backend=None) -> None:
+        self.router_for(tier).add_replica(name, backend)
+
+    def remove_replica(self, tier: str, name: str) -> None:
+        self.router_for(tier).remove_replica(name)
+
+    def plan(self, prompt, request_id=None) -> dict:
+        """-> {"decode": name, "prefill": name|None, "bypass": bool}.
+        ``prefill`` is None exactly when the bypass rule fired."""
+        decode_name = self.decode.pick(prompt, request_id=request_id)
+        full = len(prompt) // self.block_size
+        bypass = False
+        if full > 0 and self.cached_blocks_of is not None:
+            try:
+                bypass = self.cached_blocks_of(
+                    decode_name, prompt) >= full
+            except Exception:
+                bypass = False      # a dead probe must not fail routing
+        prefill_name = None
+        if not bypass:
+            prefill_name = self.prefill.pick(prompt,
+                                             request_id=request_id)
+        with self._lock:
+            self.plans += 1
+            if bypass:
+                self.prefill_bypasses += 1
+            else:
+                self.handoffs_planned += 1
+        return {"decode": decode_name, "prefill": prefill_name,
+                "bypass": bypass}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"plans": self.plans,
+                   "handoffs_planned": self.handoffs_planned,
+                   "prefill_bypasses": self.prefill_bypasses}
+        out["prefill"] = self.prefill.snapshot()
+        out["decode"] = self.decode.snapshot()
+        return out
+
+
 class GraphRouter:
     """Executes an InferenceGraph over named backends.
 

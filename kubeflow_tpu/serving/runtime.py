@@ -55,6 +55,16 @@ Env contract (all optional except the uri for real weights):
                              pre-fetches entries into it at claim time
                              (the ISVC controller suffixes it per pod)
   KFT_DEPOT_TOKEN            http depot fence (operator-injected)
+  KFT_TIER                   disaggregated serving: "prefill" | "decode"
+                             (unset = co-located). Scopes the depot key
+                             to the tier's hot program, stamps
+                             tier="..." on /metrics, and attaches the
+                             KV-migration runtime (serving/disagg.py)
+                             behind the /v2/models/{m}/disagg routes
+  KFT_KV_BIND                decode tier: host:port for the paged-KV
+                             migration listener (default 127.0.0.1:0;
+                             the ACTUAL bound port rides stats()
+                             ["disagg"]["kv_addr"] for ephemeral binds)
 """
 
 from __future__ import annotations
@@ -152,7 +162,8 @@ def build_model_from_env(env: Mapping[str, str]) -> Model:
             max_seq=int(env.get("KFT_MAX_SEQ", 1024)),
             compile_cache_dir=cache,
             scheduler=scheduler_from_env(env),
-            quant=quant_from_env(env))
+            quant=quant_from_env(env),
+            tier=env.get("KFT_TIER", ""))
     raise ValueError(f"unsupported KFT_MODEL_FORMAT {fmt!r}")
 
 
@@ -177,6 +188,19 @@ def main(argv=None) -> int:
     if env.get("KFT_STORAGE_URI") or not env.get("KFT_MODELS_CONFIG_DIR"):
         model = build_model_from_env(env)
         repo.register(model)           # load()s eagerly: warm before ready
+        tier = env.get("KFT_TIER", "")
+        if tier and getattr(model, "engine", None) is not None:
+            # disaggregated tier replica: attach the KV-migration runtime
+            # (serving/disagg.py) the server's /disagg routes dispatch to;
+            # decode pods also start the paged-KV listener
+            from kubeflow_tpu.serving.disagg import TierRuntime
+
+            model.disagg = TierRuntime(model.engine, tier, model=model)
+            if tier == "decode":
+                kv_addr = model.disagg.attach_receiver(
+                    env.get("KFT_KV_BIND") or "127.0.0.1:0")
+                print(f"disagg decode kv listener at "
+                      f"{kv_addr[0]}:{kv_addr[1]}", flush=True)
     # multi-model mode (the kserve agent/TrainedModel role): watch a config
     # directory of {"name","storage_uri",...} descriptors and hot load /
     # unload models into the same server

@@ -31,6 +31,8 @@ _V2_INFER = re.compile(r"^/v2/models/([^/:]+)/infer$")
 _V2_MODEL = re.compile(r"^/v2/models/([^/:]+)$")
 _V2_MODEL_READY = re.compile(r"^/v2/models/([^/:]+)/ready$")
 _V2_MODEL_STATS = re.compile(r"^/v2/models/([^/:]+)/stats$")
+_V2_DISAGG = re.compile(
+    r"^/v2/models/([^/:]+)/disagg/(prefill|collect|probe|release)$")
 _REPO_LOAD = re.compile(r"^/v2/repository/models/([^/:]+)/(load|unload)$")
 
 
@@ -160,6 +162,9 @@ class ModelServer:
                 m = _V1_EXPLAIN.match(path)
                 if m:
                     return self._explain(m.group(1))
+                m = _V2_DISAGG.match(path)
+                if m:
+                    return self._disagg(m.group(1), m.group(2))
                 m = _REPO_LOAD.match(path)
                 if m:
                     name, action = m.group(1), m.group(2)
@@ -302,6 +307,71 @@ class ModelServer:
                     except (BrokenPipeError, ConnectionResetError):
                         pass
 
+            def _disagg(self, name: str, op: str):
+                """Migration control plane of a disaggregated tier replica
+                (serving/disagg.TierRuntime): ``prefill`` runs a prompt to
+                first token and migrates its paged-KV to the decode_addr
+                in the body; ``collect`` blocks on an injected handoff's
+                finish; ``probe`` answers the router's bypass question
+                (cached full blocks + this pod's kv_addr); ``release``
+                drops an injected handoff (abort-on-the-wire cleanup)."""
+                try:
+                    model = outer.repository.get(name)
+                except ModelMissing as e:
+                    outer.error_count += 1
+                    return self._json(404, {"error": str(e)})
+                rt = getattr(model, "disagg", None)
+                if rt is None:
+                    outer.error_count += 1
+                    return self._json(400, {
+                        "error": f"{name!r} is not a disaggregated tier "
+                                 "replica"})
+                try:
+                    body = self._read_body()
+                    if op == "prefill":
+                        inputs = body.get("inputs", [])
+                        if isinstance(inputs, str):
+                            prompt = model.tokenizer.encode(inputs, bos=True)
+                        else:
+                            prompt = [int(t) for t in inputs]
+                        params = dict(body.get("parameters") or {})
+                        incoming = (self.headers.get(
+                            obs_trace.TRACEPARENT_HEADER)
+                            or params.get("traceparent"))
+                        host, port = body["decode_addr"]
+                        out = rt.prefill_and_migrate(
+                            prompt, model._sampling(params),
+                            (host, int(port)), str(body["handoff_id"]),
+                            trace=incoming,
+                            timeout_s=float(body.get("timeout_s", 120.0)))
+                    elif op == "collect":
+                        out = rt.collect(
+                            str(body["handoff_id"]),
+                            timeout_s=float(body.get("timeout_s", 120.0)))
+                        if "error" in out:
+                            outer.error_count += 1
+                            return self._json(409, out)
+                    elif op == "release":
+                        out = {"released":
+                               rt.release_handoff(str(body["handoff_id"]))}
+                    else:                                      # probe
+                        prompt = [int(t)
+                                  for t in body.get("inputs", [])]
+                        out = {"cached_blocks":
+                               rt.cached_prefix_blocks(prompt),
+                               "kv_addr": (list(rt.kv_addr)
+                                           if rt.kv_addr else None),
+                               "tier": rt.tier,
+                               # the router's bypass rule counts FULL
+                               # prompt blocks — its block_size must be
+                               # the engine's, not a guessed default
+                               "block_size": rt.engine.paged.block_size}
+                    return self._json(200, out)
+                except Exception as e:
+                    outer.error_count += 1
+                    return self._json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
+
             def _explain(self, name: str):
                 try:
                     model = outer.repository.get(name)
@@ -314,6 +384,10 @@ class ModelServer:
                     outer.error_count += 1
                     return self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
+        # socketserver's default listen backlog is 5 — a synchronized
+        # burst from a fleet router (or a bench driver) gets kernel RSTs
+        # past that while the accept loop waits on the GIL
+        ThreadingHTTPServer.request_queue_size = 128
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self.address: tuple[str, int] = self._server.server_address[:2]
@@ -346,12 +420,28 @@ class ModelServer:
                 stats = getattr(mdl, "stats", dict)() or {}
             except ModelMissing:
                 continue
-            label = f'model="{mname}"'
+            # tier-attributed exposition: a disaggregated replica stamps
+            # tier="prefill"|"decode" on EVERY family it exports (the
+            # request histograms included), through the one shared label
+            # builder so model= and tier= compose identically everywhere
+            label = obs_expo.format_labels(
+                model=mname, tier=stats.pop("tier", None))
             for hname, snap in (stats.pop("request_histograms", None)
                                 or {}).items():
                 hists.setdefault(
                     f"kft_model_request_{hname}_seconds",
                     []).append((label, snap))
+            # the migration plane's own families (MigrationStats snapshot
+            # riding stats()["disagg"]): kft_disagg_*, counter-vs-gauge by
+            # the same suffix rule
+            for k, v in (stats.pop("disagg", None) or {}).items():
+                if not isinstance(v, (int, float, bool)):
+                    continue
+                fam = f"kft_disagg_{k}"
+                target = (counters
+                          if fam.endswith(obs_expo.COUNTER_SUFFIXES)
+                          else gauges)
+                target.setdefault(fam, []).append((label, float(v)))
             flat = []
             for k, v in stats.items():
                 if isinstance(v, dict):

@@ -70,6 +70,39 @@ class CanarySLO:
     min_requests: int = 20
 
 
+# Role-default autoscale signal per tier: prefill is throughput-bound on
+# queued prompt tokens, decode is residency-bound on occupied slots.
+# TierSpec.scale_metric "" resolves through this map (the Autoscaler side);
+# serving/disagg.py re-exports the same two names to the data plane.
+TIER_DEFAULT_SCALE_METRIC = {
+    "prefill": "token_backlog",
+    "decode": "occupancy_slots",
+}
+
+
+@dataclasses.dataclass
+class TierSpec:
+    """One tier of a DISAGGREGATED predictor (serving/disagg.py): the
+    controller materialises a pod set per tier — same model, same
+    revision, tier-scoped depot keys — and the Autoscaler scales each
+    tier independently on its own ``kft_model_sched_*`` signal.
+
+    ``scale_metric`` "" picks the role default (prefill scales on
+    ``token_backlog``, decode on ``occupancy_slots``); ``scale_target``
+    0 inherits the predictor-level target. ``scheduler``/``quant``
+    override the predictor-level policies for this tier only (e.g. a
+    bigger prefill token quota on the prefill tier)."""
+
+    name: str                            # "prefill" | "decode"
+    min_replicas: int = 1
+    max_replicas: int = 1
+    scale_metric: str = ""               # "" = role default
+    scale_target: int = 0                # 0 = inherit predictor target
+    scheduler: Optional[SchedulerPolicy] = None
+    quant: Optional[QuantPolicy] = None
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
 @dataclasses.dataclass
 class PredictorSpec:
     model_format: ModelFormat = dataclasses.field(
@@ -98,6 +131,11 @@ class PredictorSpec:
     # KFT_QUANT_EXACT_PARITY by the ISVC controller; resolution (platform
     # support, downgrade counting) happens in the replica's engine
     quant: Optional[QuantPolicy] = None
+    # disaggregated serving: non-empty => the controller materialises one
+    # pod set PER TIER (KFT_TIER-stamped, decode pods also get
+    # KFT_KV_BIND) instead of the single co-located predictor set, and
+    # min/max_replicas above are ignored in favor of the per-tier bounds
+    tiers: list[TierSpec] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -134,6 +172,28 @@ class InferenceService:
     generation: int = 0           # bumped on every spec change
 
 
+def _parse_scheduler(sched):
+    if isinstance(sched, dict):
+        sched = dict(sched)
+        sq = sched.pop("quant", None)
+        if isinstance(sq, dict):
+            sq = QuantPolicy(**sq)
+        sched = SchedulerPolicy(**sched)
+        sched.quant = sq
+    return sched
+
+
+def _parse_tier(t) -> TierSpec:
+    if isinstance(t, TierSpec):
+        return t
+    t = dict(t)
+    t["scheduler"] = _parse_scheduler(t.get("scheduler"))
+    tq = t.get("quant")
+    if isinstance(tq, dict):
+        t["quant"] = QuantPolicy(**tq)
+    return TierSpec(**t)
+
+
 def inference_service_from_dict(d: dict) -> InferenceService:
     """JSON -> InferenceService (the operator's POST body; the apiserver
     deserialization role). Only the predictor surface — transformer/explainer
@@ -147,22 +207,17 @@ def inference_service_from_dict(d: dict) -> InferenceService:
     tpu = p.pop("tpu", None)
     if isinstance(tpu, dict):
         tpu = TPUSpec(**tpu)
-    sched = p.pop("scheduler", None)
-    if isinstance(sched, dict):
-        sched = dict(sched)
-        sq = sched.pop("quant", None)
-        if isinstance(sq, dict):
-            sq = QuantPolicy(**sq)
-        sched = SchedulerPolicy(**sched)
-        sched.quant = sq
+    sched = _parse_scheduler(p.pop("scheduler", None))
     quant = p.pop("quant", None)
     if isinstance(quant, dict):
         quant = QuantPolicy(**quant)
     slo = p.pop("canary_slo", None)
     if isinstance(slo, dict):
         slo = CanarySLO(**slo)
+    tiers = [_parse_tier(t) for t in (p.pop("tiers", None) or [])]
     predictor = PredictorSpec(model_format=fmt, tpu=tpu, scheduler=sched,
-                              quant=quant, canary_slo=slo, **p)
+                              quant=quant, canary_slo=slo, tiers=tiers,
+                              **p)
     return InferenceService(
         name=d["name"], namespace=d.get("namespace", "default"),
         labels=dict(d.get("labels", {})), predictor=predictor)

@@ -1,0 +1,500 @@
+"""Disaggregated prefill/decode serving tests (serving/disagg.py).
+
+Covers the ownership-handoff state machine end to end: engine hold/
+export/inject hooks, TCP KV migration with parity vs co-located greedy
+decode, the abort/duplicate/eviction/death races, tier-aware ISVC
+reconcile + per-tier autoscaling (incl. the router-saturation scale-up
+trigger), tier-labelled exposition, and the TieredRouter bypass rule.
+"""
+
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.controller import FakeCluster, PodPhase
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.obs.expo import format_labels, validate_exposition
+from kubeflow_tpu.obs.histogram import Histogram
+from kubeflow_tpu.serving.controller import (
+    Autoscaler, RuntimeRegistry, ServingController, ServingTicker,
+)
+from kubeflow_tpu.serving.disagg import (
+    KVMigrator, MigrationStats, TierRuntime,
+)
+from kubeflow_tpu.serving.jax_model import LLMModel
+from kubeflow_tpu.serving.llm import LLMEngine, SamplingParams
+from kubeflow_tpu.serving.model import Model, ModelRepository
+from kubeflow_tpu.serving.paged_kv import blocks_for
+from kubeflow_tpu.serving.router import TieredRouter
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.serving.types import (
+    InferenceService, ModelFormat, PredictorSpec, ServingRuntime, TierSpec,
+    inference_service_from_dict,
+)
+
+PROMPT = [5, 6, 7, 9, 10, 11, 12, 13, 3, 4, 2, 8]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def ref_greedy(params, cfg, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits = llama.forward(params, jnp.asarray([toks]), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _eng(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return LLMEngine(params, cfg, **kw)
+
+
+def _step_until(eng, pred, max_steps=300):
+    for _ in range(max_steps):
+        if pred():
+            return True
+        if not eng.has_work():
+            break
+        eng.step()
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def tier_pair(tiny):
+    """A model-backed prefill/decode replica pair joined by a live TCP
+    KV listener — the in-process version of two tier pods."""
+    cfg, params = tiny
+
+    def mk(tier):
+        m = LLMModel(f"m-{tier}", params, cfg, max_batch=4, max_seq=64,
+                     prefill_buckets=(8, 16), tier=tier)
+        m.load()
+        rt = TierRuntime(m.engine, tier, model=m)
+        m.disagg = rt
+        return m, rt
+
+    mp, rp = mk("prefill")
+    md, rd = mk("decode")
+    rd.attach_receiver()
+    yield rp, rd
+    mp.unload()
+    md.unload()
+
+
+# ------------------------------------------------- engine-level hooks --
+
+def test_hold_export_release_lifecycle(tiny):
+    eng = _eng(tiny)
+    req = eng.add_request(PROMPT, SamplingParams(max_tokens=8),
+                          hold_after_prefill=True)
+    assert _step_until(eng, lambda: req.t_first_token > 0)
+    # parked, not decoding: the slot left the active map but stays owned
+    assert req in eng.held_requests()
+    assert not req.done
+    payload = eng.export_held_kv(req)
+    n_expect = blocks_for(len(PROMPT), eng.paged.block_size)
+    assert payload["n_blocks"] == n_expect
+    assert payload["blocks"]["k"].shape[1] == n_expect
+    assert isinstance(payload["blocks"]["k"], np.ndarray)
+    assert payload["prompt"] == PROMPT
+    cfg, params = tiny
+    assert payload["first_token"] == ref_greedy(params, cfg, PROMPT, 1)[0]
+    assert payload["t_enqueue"] == req.t_enqueue
+    # ownership edge: release drops the held slot; a second export is None
+    assert eng.release_held(req)
+    assert req not in eng.held_requests()
+    assert eng.export_held_kv(req) is None
+
+
+def test_abort_before_export_releases_prefill_side(tiny):
+    """Race (a), prefill half: an abort while PREFILL_OWNED drains the
+    held slot on the next step — export then refuses (returns None), so
+    nothing ever reaches the wire."""
+    eng = _eng(tiny)
+    req = eng.add_request(PROMPT, SamplingParams(max_tokens=8),
+                          hold_after_prefill=True)
+    assert _step_until(eng, lambda: req.t_first_token > 0)
+    eng.abort([req])
+    eng.step()                         # abort drain scans the held set
+    assert req not in eng.held_requests()
+    assert req.done and req.finish_reason == "abort"
+    assert eng.export_held_kv(req) is None
+    # the freed slot readmits: the pool did not leak
+    req2 = eng.add_request(PROMPT, SamplingParams(max_tokens=4))
+    assert _step_until(eng, lambda: req2.done)
+
+
+def test_inject_pins_blocks_against_eviction(tiny):
+    """Race (b): decode-side eviction pressure can never reclaim a
+    migrated request's blocks — inject refcounts them at reserve, and
+    evict_lru skips pinned blocks by contract."""
+    src = _eng(tiny)
+    req = src.add_request(PROMPT, SamplingParams(max_tokens=8),
+                          hold_after_prefill=True)
+    assert _step_until(src, lambda: req.t_first_token > 0)
+    payload = src.export_held_kv(req)
+    src.release_held(req)
+
+    dec = _eng(tiny)
+    inj = dec.inject_request(
+        payload["prompt"],
+        SamplingParams(**{**payload["sampling"],
+                          "stop_token_ids": tuple(
+                              payload["sampling"]["stop_token_ids"])}),
+        first_token=payload["first_token"], first_lp=payload["first_lp"],
+        blocks=payload["blocks"], n_blocks=payload["n_blocks"])
+    assert inj is not None
+    ids = set(dec.paged.slot_blocks(inj.slot))
+    assert all(dec.paged._ref.get(b, 0) >= 1 for b in ids)
+    # maximum pressure: evict everything evictable — none of the
+    # migrated blocks may go
+    evicted = dec.paged.radix.evict_lru(10_000, dec.paged._ref)
+    assert not (set(evicted) & ids)
+    # and the stream still decodes to exact greedy parity
+    assert _step_until(dec, lambda: inj.done)
+    cfg, params = tiny
+    ref = ref_greedy(params, cfg, PROMPT, 8)
+    assert [payload["first_token"]] + inj.generated[1:] == ref
+    assert inj.generated == ref
+
+
+# ------------------------------------------------- wire-level handoff --
+
+def test_migration_end_to_end_parity(tiny, tier_pair):
+    rp, rd = tier_pair
+    cfg, params = tiny
+    out = rp.prefill_and_migrate(PROMPT, SamplingParams(max_tokens=8),
+                                 rd.kv_addr, "e2e-1")
+    assert out["status"] == "migrated", out
+    assert out["migrated_blocks"] > 0
+    assert out["timings"]["prefill_s"] > 0
+    assert out["timings"]["export_s"] >= 0
+    res = rd.collect("e2e-1")
+    assert res["finish_reason"] == "length"
+    assert res["tokens"] == ref_greedy(params, cfg, PROMPT, 8)
+    assert res["timings"]["inject_to_first_commit_s"] > 0
+    assert rp.stats.get("migrations_total") >= 1
+    assert rp.stats.get("migrated_blocks_total") >= out["migrated_blocks"]
+    assert rd.stats.get("handoffs_injected_total") >= 1
+
+
+def test_duplicate_delivery_is_idempotent(tiny, tier_pair):
+    """Race (c): the same kv frame delivered twice (transport retry)
+    injects ONCE — the second delivery replays the stored ack."""
+    rp, rd = tier_pair
+    src = _eng(tiny)
+    req = src.add_request(PROMPT, SamplingParams(max_tokens=6),
+                          hold_after_prefill=True)
+    assert _step_until(src, lambda: req.t_first_token > 0)
+    payload = src.export_held_kv(req)
+    src.release_held(req)
+
+    injected0 = rd.stats.get("handoffs_injected_total")
+    dup0 = rd.stats.get("duplicate_deliveries_total")
+    mig = KVMigrator(MigrationStats())
+    ok1, _ = mig.send(rd.kv_addr, "dup-1", payload)
+    ok2, _ = mig.send(rd.kv_addr, "dup-1", payload)
+    assert ok1 and ok2
+    assert rd.stats.get("handoffs_injected_total") == injected0 + 1
+    assert rd.stats.get("duplicate_deliveries_total") == dup0 + 1
+    res = rd.collect("dup-1")
+    cfg, params = tiny
+    assert res["tokens"] == ref_greedy(params, cfg, PROMPT, 6)
+
+
+def test_release_frame_drops_injected_handoff(tiny, tier_pair):
+    """Race (a), decode half: an abort while the payload was already
+    delivered sends a release frame — the injected request aborts and
+    its handoff id is forgotten (collect refuses)."""
+    rp, rd = tier_pair
+    src = _eng(tiny)
+    req = src.add_request(PROMPT, SamplingParams(max_tokens=48),
+                          hold_after_prefill=True)
+    assert _step_until(src, lambda: req.t_first_token > 0)
+    payload = src.export_held_kv(req)
+    src.release_held(req)
+
+    mig = KVMigrator(MigrationStats())
+    ok, _ = mig.send(rd.kv_addr, "rel-1", payload)
+    assert ok
+    rel0 = rd.stats.get("releases_total")
+    assert mig.release(rd.kv_addr, "rel-1")
+    deadline = time.monotonic() + 10
+    while (rd.stats.get("releases_total") == rel0
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert rd.stats.get("releases_total") == rel0 + 1
+    assert "error" in rd.collect("rel-1", timeout_s=1.0)
+
+
+def test_decode_death_falls_back_to_local_generation(tiny, tier_pair):
+    """Race (d): decode pod dead at send time -> the prefill pod
+    re-serves locally (radix-warm re-prefill) and the failure is
+    counted."""
+    rp, rd = tier_pair
+    cfg, params = tiny
+    # a port that refuses connections: bind, close, reuse the number
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = s.getsockname()
+    s.close()
+    fail0 = rp.stats.get("migration_failures_total")
+    out = rp.prefill_and_migrate(PROMPT, SamplingParams(max_tokens=8),
+                                 dead, "dead-1")
+    assert out["status"] == "fallback", out
+    assert out["tokens"] == ref_greedy(params, cfg, PROMPT, 8)
+    assert rp.stats.get("migration_failures_total") == fail0 + 1
+
+
+# ------------------------------------------------------- spec + types --
+
+def test_tier_spec_parsing():
+    isvc = inference_service_from_dict({
+        "name": "m",
+        "predictor": {
+            "tiers": [
+                {"name": "prefill", "min_replicas": 2, "max_replicas": 4,
+                 "scale_target": 512,
+                 "scheduler": {"prefill_tokens_per_step": 256}},
+                {"name": "decode", "min_replicas": 1, "max_replicas": 3,
+                 "quant": {"kv_dtype": "int8"}},
+            ],
+        },
+    })
+    tiers = isvc.predictor.tiers
+    assert [t.name for t in tiers] == ["prefill", "decode"]
+    assert tiers[0].scheduler.prefill_tokens_per_step == 256
+    assert tiers[0].scale_target == 512
+    assert tiers[1].quant.kv_dtype == "int8"
+    assert tiers[1].scale_metric == ""       # role default resolves later
+
+
+def _tiered_isvc(**kw):
+    return InferenceService(
+        name="m", predictor=PredictorSpec(
+            model_format=ModelFormat("jax"),
+            tiers=[TierSpec("prefill", min_replicas=2, max_replicas=4,
+                            scale_target=512),
+                   TierSpec("decode", min_replicas=1, max_replicas=3,
+                            scale_target=4)], **kw))
+
+
+def _serving_ctl():
+    cluster = FakeCluster()
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(
+        name="jax-runtime", supported_formats=[ModelFormat("jax")],
+        env={"KFT_DEPOT_CACHE": "/tmp/depot"}))
+    return ServingController(cluster, reg), cluster
+
+
+def _ready_all(cluster):
+    for (ns, name), pod in list(cluster.pods.items()):
+        if pod.phase == PodPhase.PENDING:
+            cluster.set_phase(ns, name, PodPhase.RUNNING)
+
+
+def test_controller_materialises_tier_pod_sets():
+    ctl, cluster = _serving_ctl()
+    isvc = _tiered_isvc()
+    ctl.apply(isvc)
+    pods = {p.name: p for p in cluster.pods.values()}
+    assert set(pods) == {"m-predictor-prefill-rev1-0",
+                         "m-predictor-prefill-rev1-1",
+                         "m-predictor-decode-rev1-0"}
+    pre = pods["m-predictor-prefill-rev1-0"]
+    dec = pods["m-predictor-decode-rev1-0"]
+    # component label stays "predictor" (service selector / readiness are
+    # tier-blind); the tier rides its own label + env
+    assert pre.labels["component"] == dec.labels["component"] == "predictor"
+    assert pre.labels["tier"] == "prefill"
+    assert dec.labels["tier"] == "decode"
+    assert pre.env["KFT_TIER"] == "prefill"
+    assert dec.env["KFT_TIER"] == "decode"
+    # only decode pods get the KV listener bind
+    assert "KFT_KV_BIND" not in pre.env
+    assert dec.env["KFT_KV_BIND"]
+    assert dec.env["KFT_KV_BIND"] != dec.env["KFT_BIND"]
+    # pod-local depot cache still suffixes per pod
+    assert pre.env["KFT_DEPOT_CACHE"].endswith(pre.name)
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    assert isvc.status.ready
+
+
+def test_controller_scales_tiers_independently():
+    ctl, cluster = _serving_ctl()
+    ctl.apply(_tiered_isvc())
+    _ready_all(cluster)
+    ctl.set_scale("default", "m", 3, tier="decode")
+    names = {p.name for p in cluster.pods.values()}
+    assert "m-predictor-decode-rev1-2" in names
+    assert sum(1 for n in names if "prefill" in n) == 2   # untouched
+    ctl.set_scale("default", "m", 1, tier="decode")
+    names = {p.name for p in cluster.pods.values()}
+    assert sum(1 for n in names if "decode" in n) == 1
+    assert sum(1 for n in names if "prefill" in n) == 2
+
+
+def test_autoscaler_tier_role_metrics():
+    sc = Autoscaler(idle_grace_seconds=10)
+    isvc = _tiered_isvc()
+    pre, dec = isvc.predictor.tiers
+    # prefill scales on token_backlog at scale_target tokens/replica
+    sig = [{"tier": "prefill", "token_backlog": 1500, "queue_depth": 0,
+            "occupancy_slots": 0}]
+    assert sc.scale(isvc, signals=sig, current=1, tier=pre, now=0.0) == 3
+    # decode ignores backlog, scales on occupied slots + queue
+    sig = [{"tier": "decode", "token_backlog": 1500, "queue_depth": 2,
+            "occupancy_slots": 6}]
+    assert sc.scale(isvc, signals=sig, current=1, tier=dec, now=0.0) == 2
+    # per-tier clamps
+    sig = [{"tier": "decode", "occupancy_slots": 400, "queue_depth": 0}]
+    assert sc.scale(isvc, signals=sig, current=2, tier=dec, now=1.0) == 3
+
+
+def test_autoscaler_spill_saturation_trigger():
+    """Satellite: FleetRouter.spill_saturated rising across consecutive
+    ticks adds a replica even when per-replica signals plateau below the
+    demand line."""
+    sc = Autoscaler(idle_grace_seconds=10, spill_saturation_ticks=2)
+    isvc = InferenceService(
+        name="m", predictor=PredictorSpec(min_replicas=1, max_replicas=5,
+                                          scale_target=8))
+    flat = [{"occupancy_slots": 8, "queue_depth": 0}]   # exactly 1 replica
+    assert sc.scale(isvc, signals=flat, current=1, now=0.0,
+                    spill_saturated=0) == 1
+    assert sc.scale(isvc, signals=flat, current=1, now=1.0,
+                    spill_saturated=5) == 1          # one rise: not yet
+    assert sc.scale(isvc, signals=flat, current=1, now=2.0,
+                    spill_saturated=9) == 2          # sustained: scale up
+    # a FLAT counter (no new saturation) never re-triggers
+    assert sc.scale(isvc, signals=flat, current=2, now=3.0,
+                    spill_saturated=9) == 2
+    assert sc.scale(isvc, signals=flat, current=2, now=4.0,
+                    spill_saturated=9) == 2
+
+
+def test_ticker_wires_router_saturation_per_tier():
+    class _R:
+        def __init__(self):
+            self.spill_saturated = 0
+
+        def snapshot(self):
+            return {"spill_saturated": self.spill_saturated}
+
+    class _TR:
+        def __init__(self):
+            self.prefill, self.decode = _R(), _R()
+
+        def router_for(self, t):
+            return getattr(self, t)
+
+    ctl, cluster = _serving_ctl()
+    ctl.apply(_tiered_isvc())
+    _ready_all(cluster)
+    ctl.reconcile("default", "m")
+    router = _TR()
+    ticker = ServingTicker(
+        ctl, Autoscaler(idle_grace_seconds=100, spill_saturation_ticks=2),
+        concurrency_of=lambda isvc: 0.0,
+        signals_of=lambda isvc: [],
+        router_of=lambda isvc: router)
+    isvc = ctl.get("default", "m")
+    for _ in range(3):
+        router.decode.spill_saturated += 7    # decode tier saturating
+        _ready_all(cluster)
+        ticker.tick()
+    assert ctl._predictor_replicas(isvc, tier="decode") == 2
+    assert ctl._predictor_replicas(isvc, tier="prefill") == 2  # untouched
+
+
+# --------------------------------------------------------- exposition --
+
+class _TierStatsModel(Model):
+    def __init__(self):
+        super().__init__("m")
+        self.ready = True
+
+    def stats(self):
+        h = Histogram()
+        h.observe(0.2)
+        return {"tier": "decode",
+                "sched": {"queue_depth": 1, "occupancy_slots": 2},
+                "disagg": {"migrations_total": 3,
+                           "imported_blocks_total": 12,
+                           "handoffs_live": 1,
+                           "kv_addr": ["127.0.0.1", 9]},   # non-numeric
+                "request_histograms": {"ttft": h.snapshot()}}
+
+
+def test_metrics_tier_label_and_disagg_families():
+    """Satellite: tier="..." rides every family a tier replica exports —
+    request histograms included — and the kft_disagg_* families render
+    through the shared exposition helper, lint-clean."""
+    repo = ModelRepository()
+    repo.register(_TierStatsModel())
+    srv = ModelServer(repo)
+    try:
+        text = srv._render_metrics()
+    finally:
+        # stop() joins serve_forever, which never ran here
+        srv._server.server_close()
+    assert validate_exposition(text) == []
+    assert 'kft_disagg_migrations_total{model="m",tier="decode"} 3.0' \
+        in text
+    assert 'kft_disagg_handoffs_live{model="m",tier="decode"} 1.0' in text
+    assert 'kft_model_sched_queue_depth{model="m",tier="decode"}' in text
+    # histogram components carry the tier label too
+    assert 'kft_model_request_ttft_seconds_count{model="m",tier="decode"}' \
+        in text
+    # the non-numeric kv_addr never leaks into the exposition
+    assert "kv_addr" not in text
+
+
+def test_format_labels_helper():
+    assert format_labels(model="m", tier="decode") == \
+        'model="m",tier="decode"'
+    assert format_labels(model="m", tier=None) == 'model="m"'
+    assert format_labels(model="m", tier="") == 'model="m"'
+    assert format_labels() is None
+    assert format_labels(x='a"b\\c') == 'x="a\\"b\\\\c"'
+
+
+# -------------------------------------------------------------- router --
+
+def test_tiered_router_bypass_rule():
+    cached = {"d0": 0}
+    tr = TieredRouter(block_size=4,
+                      cached_blocks_of=lambda name, prompt: cached[name])
+    tr.add_replica("prefill", "p0")
+    tr.add_replica("decode", "d0")
+    prompt = list(range(9))             # 2 full blocks + tail
+    plan = tr.plan(prompt)
+    assert plan == {"decode": "d0", "prefill": "p0", "bypass": False}
+    cached["d0"] = 2                    # both full blocks radix-resident
+    plan = tr.plan(prompt)
+    assert plan["bypass"] and plan["prefill"] is None
+    snap = tr.snapshot()
+    assert snap["plans"] == 2
+    assert snap["handoffs_planned"] == 1
+    assert snap["prefill_bypasses"] == 1
+    # a dying probe must degrade to the handoff path, not fail routing
+    tr2 = TieredRouter(block_size=4, cached_blocks_of=lambda n, p: 1 / 0)
+    tr2.add_replica("prefill", "p0")
+    tr2.add_replica("decode", "d0")
+    assert tr2.plan(prompt)["bypass"] is False
