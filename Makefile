@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg test-swarm native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg test-swarm dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -324,6 +324,44 @@ test-disagg:
 			+ ' handoff_p95=' + str(mdc['prefill_done_to_first_commit_s'].get('p95_s')) \
 			+ ' ttft_p95 co=' + str(hl['ttft_colocated_s']) + ' dsg=' + str(hl['ttft_disagg_s']) \
 			+ ' itl_p95 co=' + str(hl['itl_colocated_s']) + ' dsg=' + str(hl['itl_disagg_s']))"
+
+# Podracer trial swarm e2e (ISSUE 18): the swarm unit suite
+# (shared-compile fingerprint keying, one-publish-then-hits through a
+# real depot, reclaim races — kill vs completion exactly one terminal
+# state, token fence against a stale trial's late exec, dead/gone pod
+# counted no-op, concurrent convergence — suggestion determinism across
+# controller restart, operator metric surface), then the swarm bench
+# smoke. Two independent teeth (like test-elastic): bench.py exits
+# nonzero unless trials REALLY claimed warm zygote pods, the
+# shared-compile invariant held (depot publishes == distinct structural
+# configs, every other recorded trial a hit, zero local compiles), at
+# least one early-stopped trial's pod completed a reclaim→re-claim
+# cycle, and trials_per_hour was measured; the JSON contract is then
+# re-checked from the captured file so a silently-vanished counter or
+# a collapsed warm path regresses visibly.
+SWARM_SMOKE_JSON := /tmp/kft-swarm-smoke.json
+test-swarm:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_swarm.py -x -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --swarm-smoke > $(SWARM_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(SWARM_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; s = e['swarm']; sc = e['shared_compile']; \
+		dec = e['submit_to_first_step']; \
+		assert s['warm_claims'] >= 1, ('no warm claim', d); \
+		assert sc['holds'] is True, ('shared-compile invariant broken', sc); \
+		assert sc['published'] == sc['distinct_structural_configs'], sc; \
+		assert sc['local_compiles'] == 0, ('a trial compiled locally', sc); \
+		assert e['counts'].get('EarlyStopped', 0) >= 1, ('nothing early-stopped', d); \
+		assert s['reclaims'] >= 1, ('no pod reclaimed', d); \
+		assert e['reclaim_cycles'] >= 1, ('no reclaim→re-claim cycle', d); \
+		assert dec['warm']['trials'] >= 1 and dec['warm']['total'] is not None, dec; \
+		assert e['trials_per_hour'] is not None, d; \
+		assert e['metrics_exposition']['clean'] is True, e['metrics_exposition']; \
+		assert e['trace']['coherent'] is True, e['trace']; \
+		print('swarm bench OK: trials_per_hour=' + str(e['trials_per_hour']) \
+			+ ' warm=' + str(s['warm_claims']) + '/' + str(s['trials_running']) \
+			+ ' publishes=' + str(sc['published']) + ' hits=' + str(sc['hits']) \
+			+ ' reclaim_cycles=' + str(e['reclaim_cycles']))"
 
 native:
 	$(MAKE) -C native/metadata_store

@@ -400,6 +400,19 @@ class KubeCluster:
                 if target == key:
                     self._claims.pop(alias, None)
 
+    def release_claim(self, namespace: str, name: str) -> None:
+        """Drop every job-pod-name alias pointing at ``(namespace,
+        name)`` WITHOUT deleting the pod — the warm-pool reclaim arc: a
+        returned standby keeps existing under its own name, but the
+        stopped trial's pod name must stop resolving to it (a late
+        ``get_pod``/``delete_pod`` through the alias would hit the next
+        claimant's pod)."""
+        key = (namespace, name)
+        with self._lock:
+            for alias, target in list(self._claims.items()):
+                if target == key:
+                    self._claims.pop(alias, None)
+
     def patch_pod(self, namespace: str, name: str, patch: dict,
                   expect_rv: Optional[int] = None) -> dict:
         """Generic JSON merge patch on a pod. ``expect_rv`` makes it a

@@ -149,6 +149,11 @@ class Operator:
         if experiment_manager is not None:
             serving_tickers += (
                 lambda: self._locked(experiment_manager.tick),)
+            # trial-swarm wiring (hpo/swarm.py): the manager's swarm
+            # runners post trial spans through heartbeat_post and push
+            # kft_swarm_* metrics into this operator's registry
+            if getattr(experiment_manager, "swarm_pool", None) is not None:
+                experiment_manager.operator = self
         if serving_ticker is not None:
             serving_ticker.lock = self._lock
             serving_tickers += (serving_ticker.tick,)
@@ -423,7 +428,10 @@ class Operator:
                 self.controller.metrics.get("restart_backoff_seconds", 0.0))
             self.metrics.set(
                 "kft_gang_queue_depth",
-                sum(1 for g in getattr(self.controller.scheduler, "groups", {})
+                # snapshot: submit/forget churn (e.g. a trial swarm)
+                # mutates groups from other threads mid-iteration
+                sum(1 for g in list(
+                        getattr(self.controller.scheduler, "groups", {}))
                     if not self.controller.scheduler.is_admitted(*g))
                 if hasattr(self.controller.scheduler, "groups") else pending,
             )
@@ -654,7 +662,7 @@ class Operator:
         last = getattr(self, "_warm_pool_exported", {})
         for k in ("claims", "fallbacks", "dead_claims", "claim_errors",
                   "created", "reaped", "prefetched_entries",
-                  "prefetch_errors"):
+                  "prefetch_errors", "reclaims", "reclaim_noops"):
             self.metrics.inc(f"kft_warm_pool_{k}_total",
                              by=snap[k] - last.get(k, 0))
         self._warm_pool_exported = snap

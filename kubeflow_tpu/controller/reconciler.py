@@ -178,16 +178,34 @@ class JobController:
         job = self.jobs.pop((namespace, name), None)
         if job:
             self._delete_pods(job)
-            self.cluster.delete_service(namespace, job.name)
-            for sid in range(_pipeline_stages(job)):
-                self.cluster.delete_service(
-                    namespace, _stage_service_name(job, sid))
-            self.scheduler.remove_group(namespace, job.name)
-            self._requeue_at.pop((namespace, name), None)
-            self._replacing.pop((namespace, name), None)
-            self.recovery_log.pop((namespace, name), None)
-            if self.job_store is not None:
-                self.job_store.delete(job)
+            self._drop_bookkeeping(job)
+
+    def forget(self, namespace: str, name: str) -> Optional[JobSpec]:
+        """Remove a job from the controller WITHOUT deleting its pods —
+        the warm-pool reclaim arc (hpo/swarm.py): an early-stopped
+        trial's claimed pod goes back to the pool, so the job record must
+        stop reconciling FIRST (a reconcile pass between un-labeling the
+        pod and deleting the job would see a vanished worker and start
+        elastic recovery), and its selector-driven pod cleanup must never
+        run. The caller owns the leftover pods. Returns the forgotten
+        JobSpec, or None."""
+        job = self.jobs.pop((namespace, name), None)
+        if job:
+            self._drop_bookkeeping(job)
+        return job
+
+    def _drop_bookkeeping(self, job: JobSpec) -> None:
+        namespace, name = job.namespace, job.name
+        self.cluster.delete_service(namespace, job.name)
+        for sid in range(_pipeline_stages(job)):
+            self.cluster.delete_service(
+                namespace, _stage_service_name(job, sid))
+        self.scheduler.remove_group(namespace, job.name)
+        self._requeue_at.pop((namespace, name), None)
+        self._replacing.pop((namespace, name), None)
+        self.recovery_log.pop((namespace, name), None)
+        if self.job_store is not None:
+            self.job_store.delete(job)
 
     # ---------------- reconcile ----------------
 

@@ -52,6 +52,12 @@ from kubeflow_tpu.controller.kube import (
 POOL_CLASS_LABEL = "kubeflow-tpu.org/warm-pool"    # value: pool class key
 POOL_STATE_LABEL = "kubeflow-tpu.org/warm-state"   # "standby" | "claimed"
 ZYGOTE_ADDR_ANNOTATION = "kubeflow-tpu.org/zygote-addr"
+# the ROTATED exec token after a reclaim. Pod spec env is immutable, so a
+# reclaimed pod's fresh token cannot live where the original did — it is
+# published as an annotation, and _try_claim prefers it over the spec env.
+# Same trust domain either way: reading annotations needs apiserver
+# pod-read rights, which already imply claim rights.
+ZYGOTE_TOKEN_ANNOTATION = "kubeflow-tpu.org/zygote-token"
 ZYGOTE_PORT = 8479          # the fixed containerPort on a real cluster
 
 _TERMINAL = (PodPhase.SUCCEEDED, PodPhase.FAILED)
@@ -84,6 +90,24 @@ class _ClaimWatcher(threading.Thread):
         self.conn = conn
         self.pending = pending
         self.exit_code: Optional[int] = None
+        # reclaim handshake: disarm() and the terminal report race over
+        # one lock, so exactly ONE of them wins — either the worker's
+        # exit marks the pod terminal, or the reclaim suppresses that
+        # and the pod goes back to standby. Never both.
+        self._report_lock = threading.Lock()
+        self._disarmed = False
+        self.reported = False
+
+    def disarm(self) -> bool:
+        """Suppress the terminal phase report (reclaim path). Returns
+        True if disarmed BEFORE any report — the reclaim may proceed;
+        False if the exit was already reported — the worker finished
+        first, the pod is terminal, and the reclaim must no-op."""
+        with self._report_lock:
+            if self.reported:
+                return False
+            self._disarmed = True
+            return True
 
     def run(self) -> None:
         buf = self.pending
@@ -104,11 +128,19 @@ class _ClaimWatcher(threading.Thread):
                 pass
             phase = (PodPhase.SUCCEEDED if self.exit_code == 0
                      else PodPhase.FAILED)
-            try:
-                self.cluster.set_phase(
-                    self.namespace, self.pod_name, phase, self.exit_code)
-            except Exception:
-                pass        # apiserver gone (shutdown): nothing to report to
+            with self._report_lock:
+                if self._disarmed:
+                    # reclaimed mid-run: the zygote killed the worker and
+                    # the pod is headed back to standby — a terminal PATCH
+                    # here would wedge it (terminal-wins, never resurrected)
+                    return
+                try:
+                    self.cluster.set_phase(
+                        self.namespace, self.pod_name, phase,
+                        self.exit_code)
+                except Exception:
+                    pass    # apiserver gone (shutdown): nothing to report to
+                self.reported = True
 
 
 class WarmPoolController:
@@ -151,6 +183,14 @@ class WarmPoolController:
         # worker forks, so its compile phase is a cache read
         self.prefetched_entries = 0
         self.prefetch_errors = 0
+        # reclaim arc (claimed -> running -> reclaimed -> claimable):
+        # early-stopped trials RETURN their pod instead of deleting it
+        self.reclaims = 0        # pods returned to standby, re-claimable
+        self.reclaim_noops = 0   # reclaim of a finished/dead/gone pod
+        # live claim watchers by claimed pod key — reclaim must disarm
+        # the exit reporter before the zygote kills the worker, or the
+        # kill itself would mark the returning pod terminal
+        self._watchers: dict = {}
 
     # ------------------------------------------------------ eligibility --
 
@@ -268,6 +308,8 @@ class WarmPoolController:
             "reaped": self.reaped,
             "prefetched_entries": self.prefetched_entries,
             "prefetch_errors": self.prefetch_errors,
+            "reclaims": self.reclaims,
+            "reclaim_noops": self.reclaim_noops,
             "standby": self.standby_count(),
         }
 
@@ -339,8 +381,10 @@ class WarmPoolController:
             return None
         # we own the pod now — start the worker in it. The exec token is
         # read from the SERVER manifest (not local state) so a restarted
-        # controller adopting the pool can still claim.
-        token = next(
+        # controller adopting the pool can still claim. A reclaimed pod's
+        # token was ROTATED (pod spec env is immutable) and lives in the
+        # token annotation, which wins over the spec env original.
+        token = ann.get(ZYGOTE_TOKEN_ANNOTATION) or next(
             (e.get("value", "") for c in (doc.get("spec") or {}).get(
                 "containers", [{}])[:1]
              for e in (c.get("env") or [])
@@ -369,7 +413,9 @@ class WarmPoolController:
             self._reap(cand)
             return None
         # the watcher thread owns its own lifetime (daemon thread holding
-        # the claim connection); no registry needed
+        # the claim connection); the registry exists only so reclaim()
+        # can disarm the exit report before killing the worker
+        self._watchers[(cand.namespace, cand.name)] = watcher
         # fold the new identity into the local object too (the patch_pod
         # fold already synced labels; env is local-only state)
         cand.labels.update(patch["metadata"]["labels"])
@@ -443,3 +489,146 @@ class WarmPoolController:
                                 conn, pending=rest)
         watcher.start()
         return watcher
+
+    # ---------------------------------------------------------- reclaim --
+
+    def reclaim(self, namespace: str, pod_name: str) -> bool:
+        """Return a CLAIMED pod to the pool as a claimable standby — the
+        early-stop arc: claimed → running → reclaimed → claimable.
+
+        Order matters: (1) disarm the exit watcher, so the kill below is
+        not reported as a terminal pod phase (terminal-wins would wedge
+        the pod un-claimable forever); if the worker already finished and
+        reported, completion won the race — counted no-op, exactly one
+        terminal outcome. (2) Ask the resident zygote to SIGKILL the
+        worker's process group and ROTATE its exec token, fencing out any
+        late exec from the stopped trial. (3) CAS-patch the pod back to
+        pool-only labels (job labels nulled out, so the job's selector —
+        and its pod cleanup — can never touch the returned pod) with the
+        fresh token as an annotation. (4) Drop the job-pod-name alias.
+
+        Every failure path is a counted no-op (``reclaim_noops``), never
+        a crash: a dead zygote is marked FAILED and reaped (replenish
+        covers it), a lost CAS means someone else moved the pod first."""
+        import uuid
+
+        key = (namespace, pod_name)
+        try:
+            doc = self.cluster._request(
+                "GET", self.cluster._pod_path(namespace, pod_name))
+        except (KubeApiError, OSError):
+            self.reclaim_noops += 1         # already deleted/apiserver gone
+            return False
+        meta = doc.get("metadata") or {}
+        ann = meta.get("annotations") or {}
+        labels = meta.get("labels") or {}
+        addr = ann.get(ZYGOTE_ADDR_ANNOTATION)
+        if (labels.get(POOL_STATE_LABEL) != "claimed" or not addr
+                or (doc.get("status") or {}).get("phase") != "Running"):
+            # not ours to return: a cold-fallback pod (no pool labels), a
+            # pod that already went terminal, or a double reclaim. The
+            # watcher (if any) stays armed — a still-running worker's
+            # eventual exit must keep reporting.
+            self.reclaim_noops += 1
+            return False
+        # validated against the live manifest — NOW take the exit report
+        # out of play. disarm() losing means the worker finished between
+        # the GET and here: completion won, its terminal report stands
+        # (our stale-rv CAS below could not have landed anyway).
+        watcher = self._watchers.get(key)
+        if watcher is not None and not watcher.disarm():
+            self._watchers.pop(key, None)
+            self.reclaim_noops += 1
+            return False
+        old_token = ann.get(ZYGOTE_TOKEN_ANNOTATION) or next(
+            (e.get("value", "") for c in (doc.get("spec") or {}).get(
+                "containers", [{}])[:1]
+             for e in (c.get("env") or [])
+             if e.get("name") == "KFT_ZYGOTE_TOKEN"), "")
+        new_token = uuid.uuid4().hex
+        if not self._reclaim_rpc(addr, old_token, new_token):
+            # dead zygote: the pod cannot serve another claim — make the
+            # death visible and let reconcile replenish
+            self.reclaim_noops += 1
+            try:
+                self.cluster.set_phase(
+                    namespace, pod_name, PodPhase.FAILED, -1)
+            except (KubeApiError, OSError):
+                pass
+            pod = self.cluster.get_pod(namespace, pod_name)
+            if pod is not None:
+                self._reap(pod)
+            self._watchers.pop(key, None)
+            return False
+        try:
+            rv = int(meta.get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            rv = None
+        cls = labels.get(POOL_CLASS_LABEL, "default")
+        patch = {"metadata": {
+            # null out every claimed-on label (job-name/job-uid/replica-*/
+            # experiment/...) so the trial job's selector no longer
+            # matches; keep only the pool identity, back in standby
+            "labels": {**{k: None for k in labels
+                          if k not in (POOL_CLASS_LABEL, POOL_STATE_LABEL)},
+                       POOL_CLASS_LABEL: cls,
+                       POOL_STATE_LABEL: "standby"},
+            "annotations": {
+                CLAIMED_AS_ANNOTATION: None,
+                ZYGOTE_TOKEN_ANNOTATION: new_token,
+                # the stopped trial's late-bound env must not leak into
+                # the next claimant's reconstruction
+                **{k: None for k in ann
+                   if k.startswith(ENV_ANNOTATION_PREFIX)},
+            }}}
+        try:
+            self.cluster.patch_pod(namespace, pod_name, patch,
+                                   expect_rv=rv)
+        except (KubeApiError, OSError):
+            # lost the CAS (reaper/concurrent mutation bumped rv) AFTER
+            # the worker was killed and the token rotated: the pod can
+            # neither serve its old claim nor be proven standby — fail it
+            # so reconcile reaps and replenishes, counted no-op
+            self.reclaim_noops += 1
+            try:
+                self.cluster.set_phase(
+                    namespace, pod_name, PodPhase.FAILED, -1)
+            except (KubeApiError, OSError):
+                pass
+            self._watchers.pop(key, None)
+            return False
+        release = getattr(self.cluster, "release_claim", None)
+        if release is not None:
+            release(namespace, pod_name)
+        self._watchers.pop(key, None)
+        self.reclaims += 1
+        return True
+
+    def _reclaim_rpc(self, addr: str, old_token: str,
+                     new_token: str) -> bool:
+        """Kill-and-rotate request to the resident zygote. False = the
+        zygote is unreachable or refused (dead pod, wrong token)."""
+        host, _, port = addr.rpartition(":")
+        try:
+            conn = socket.create_connection(
+                (host, int(port)), timeout=self.dial_timeout_s)
+        except (OSError, ValueError):
+            return False
+        try:
+            conn.sendall(json.dumps(
+                {"reclaim": True, "token": old_token,
+                 "new_token": new_token}).encode() + b"\n")
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return False
+                buf += chunk
+            return bool(json.loads(buf.split(b"\n", 1)[0]).get("reclaimed"))
+        except (OSError, ValueError):
+            return False
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

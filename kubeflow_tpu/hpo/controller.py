@@ -139,6 +139,9 @@ class JobTrialRunner(TrialRunner):
     def metrics_path(self, trial_name: str) -> str:
         return os.path.join(self.metrics_dir, f"{trial_name}.jsonl")
 
+    def _prepare_job(self, job: JobSpec, trial, experiment) -> None:
+        pass
+
     def start(self, trial, experiment):
         job = self.template(trial.name, dict(trial.parameters))
         job.name = trial.name
@@ -147,6 +150,9 @@ class JobTrialRunner(TrialRunner):
         job.labels["experiment"] = experiment.name
         for spec in job.replica_specs.values():
             spec.template.env["KFT_METRICS_PATH"] = self.metrics_path(trial.name)
+        # subclass hook, called after env wiring and before submit — the
+        # swarm runner shapes per-trial env here (depot follower wait)
+        self._prepare_job(job, trial, experiment)
         try:
             self.jobs.submit(job)
         except Exception as e:

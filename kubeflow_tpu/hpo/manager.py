@@ -47,16 +47,32 @@ class ExperimentManager:
     """Owns the live ExperimentControllers of one daemon process."""
 
     def __init__(self, jobs: JobController, metrics_dir: str,
-                 store: Optional[ExperimentStore] = None):
+                 store: Optional[ExperimentStore] = None,
+                 swarm_pool=None, structural_keys=()):
         self.jobs = jobs
         self.metrics_dir = metrics_dir
         self.store = store
+        # trial-swarm mode (hpo/swarm.py): with a warm pool attached,
+        # trials claim standbys, share depot entries, and early-stopped
+        # pods are reclaimed. ``operator`` is attached by the Operator at
+        # construction (span/metric sink); ``structural_keys`` names the
+        # hyperparameters that fork the compiled program.
+        self.swarm_pool = swarm_pool
+        self.structural_keys = tuple(structural_keys)
+        self.operator = None
         self.controllers: dict[tuple[str, str], ExperimentController] = {}
         self._lock = threading.RLock()
 
     def _runner(self, template_yaml: str) -> JobTrialRunner:
-        return JobTrialRunner(self.jobs, render_trial_template(template_yaml),
-                              self.metrics_dir)
+        template = render_trial_template(template_yaml)
+        if self.swarm_pool is not None:
+            from kubeflow_tpu.hpo.swarm import SwarmTrialRunner
+
+            return SwarmTrialRunner(
+                self.jobs, template, self.metrics_dir,
+                pool=self.swarm_pool, operator=self.operator,
+                structural_keys=self.structural_keys)
+        return JobTrialRunner(self.jobs, template, self.metrics_dir)
 
     def submit(self, exp: Experiment, trial_template: str
                ) -> ExperimentController:

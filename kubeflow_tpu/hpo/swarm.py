@@ -1,0 +1,307 @@
+"""Trial swarm: pack many concurrent HPO trials onto the warm pool.
+
+The Podracer argument (PAPERS.md: Podracer architectures; TPU concurrency
+studies) is that accelerator utilization at small-job scale comes from
+MULTIPLEXING work onto warm hardware, not from one big job. The seeded
+HPO triangle predates every warm-start lever built since — each trial
+paid cold pod spawn + a full compile. This module is the missing
+execution layer that composes them:
+
+- **Warm claims**: trials submit through the normal job layer, whose
+  admission claims a pre-warmed standby (controller/warmpool.py) — trial
+  submit→first-step is fork + state init + a depot read, not interpreter
+  + imports + compile. A dry pool cold-falls-back, counted as
+  ``pool_starvation`` (the replenish-rate signal rides the pool's own
+  ``created`` counter).
+- **Shared compile**: scalar hyperparameters (lr, weight decay, ...) are
+  TRACED runtime arguments of the trial program (hpo/trial_worker.py),
+  so every trial of a structural config lowers to identical HLO and
+  shares ONE depot entry (``fingerprint(stage="hpo-trial")``). The
+  runner designates the first trial per structural config as the depot
+  publisher; every later one is a follower (``KFT_DEPOT_WAIT_S``) that
+  waits for the publish instead of racing it — deterministic
+  one-publish/N−1-hits instead of a thundering first batch.
+- **Early-stop reclaim**: when MedianStop/ASHA kills a trial, its pod is
+  RETURNED to the pool as a claimable zygote-warm standby
+  (``WarmPoolController.reclaim``: kill worker, rotate exec token,
+  un-label) instead of deleted — the pool self-replenishes under churn.
+  The job record is forgotten FIRST (``JobController.forget``) so no
+  reconcile pass mistakes the returning pod for a dead worker.
+- **Per-trial spans**: ``trial.claim`` / ``trial.stopped`` posted by the
+  runner and ``trial.load`` / ``trial.step`` by the worker, all through
+  the PR 10 heartbeat span path, folded into the operator job trace;
+  ``experiment_trace`` merges every trial's trace into one
+  Perfetto-loadable export.
+
+Counters surface as operator metrics (``kft_swarm_*``, rendered through
+obs/expo) and in ``snapshot()`` for bench JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from kubeflow_tpu.controller.reconciler import JobController, _job_selector
+from kubeflow_tpu.hpo.controller import JobTrialRunner
+from kubeflow_tpu.hpo.types import TrialState
+from kubeflow_tpu.obs.histogram import Histogram
+
+
+class SwarmTrialRunner(JobTrialRunner):
+    """JobTrialRunner that runs an Experiment as a warm-pool swarm.
+
+    ``pool`` is the WarmPoolController admission claims from (it must
+    also be attached as ``cluster.warm_pool``); ``operator`` (optional)
+    receives trial spans over its heartbeat path and the ``kft_swarm_*``
+    metrics; ``structural_keys`` names the hyperparameters that CHANGE
+    THE PROGRAM (width/depth — they legitimately fork the depot key);
+    everything else is assumed scalar and compile-shared.
+    """
+
+    def __init__(self, jobs: JobController, template: Callable,
+                 metrics_dir: str, *, pool, operator=None,
+                 structural_keys=(), follower_wait_s: float = 30.0):
+        super().__init__(jobs, template, metrics_dir)
+        self.pool = pool
+        self.operator = operator
+        self.structural_keys = tuple(structural_keys)
+        self.follower_wait_s = float(follower_wait_s)
+        self._lock = threading.Lock()
+        # structural configs that already have a designated depot
+        # publisher — the first trial of each config compiles+publishes,
+        # all later ones follower-wait for that entry
+        self._publishers: set[tuple] = set()
+        # per-trial records for bench/trace: claim timing, warm/cold,
+        # pod identity, stashed phases+trace for killed trials
+        self.records: dict[str, dict] = {}
+        self.claim_hist = Histogram()
+        # counters (monotonic; exported as kft_swarm_*_total)
+        self.trials_running = 0      # trials that entered RUNNING
+        self.trials_succeeded = 0
+        self.trials_failed = 0
+        self.trials_stopped = 0      # early-stopped / killed
+        self.warm_claims = 0
+        self.pool_starvation = 0     # trials that cold-fell-back
+        self.reclaims = 0            # pods returned to the pool
+        self.reclaim_noops = 0
+
+    # ------------------------------------------------------------ start --
+
+    def structural_of(self, params: dict) -> tuple:
+        return tuple(
+            (k, str(params.get(k))) for k in self.structural_keys)
+
+    def _prepare_job(self, job, trial, experiment) -> None:
+        structural = self.structural_of(trial.parameters)
+        with self._lock:
+            follower = structural in self._publishers
+            self._publishers.add(structural)
+        rec = self.records.setdefault(trial.name, {})
+        rec["structural"] = structural
+        rec["follower"] = follower
+        if follower:
+            # follower-wait for the designated publisher's depot entry
+            # instead of racing it with an identical compile; a dead
+            # transport or timeout ends the wait and compiles locally,
+            # counted (parallel/depot.py load_or_compile semantics)
+            for spec in job.replica_specs.values():
+                spec.template.env.setdefault(
+                    "KFT_DEPOT_WAIT_S", str(self.follower_wait_s))
+
+    def start(self, trial, experiment):
+        t0 = time.time()
+        super().start(trial, experiment)
+        rec = self.records.setdefault(trial.name, {})
+        rec["t_submit"] = t0
+        if trial.state != TrialState.RUNNING:
+            # admission rejected: the publisher designation must not pin
+            # this structural config on a trial that never ran
+            with self._lock:
+                if not rec.get("follower"):
+                    self._publishers.discard(rec.get("structural", ()))
+            self.trials_failed += 1
+            return
+        dt = time.time() - t0
+        ns = experiment.namespace
+        job = self.jobs.get(ns, trial.name)
+        # resolve where the trial actually runs: a warm claim aliases the
+        # job pod name to the claimed standby
+        claims = getattr(self.jobs.cluster, "_claims", {})
+        pods = (self.jobs.cluster.list_pods(ns, _job_selector(job))
+                if job is not None else [])
+        claimed = [p.name for p in pods
+                   if (p.namespace, p.name) in set(claims.values())]
+        warm = bool(claimed)
+        rec.update(claim_s=dt, warm=warm,
+                   pod=(claimed[0] if claimed
+                        else (pods[0].name if pods else "")))
+        self.trials_running += 1
+        self.claim_hist.observe(dt)
+        if warm:
+            self.warm_claims += 1
+        else:
+            self.pool_starvation += 1
+        self._metric("inc", "kft_swarm_trials_running_total", experiment)
+        if not warm:
+            self._metric("inc", "kft_swarm_pool_starvation_total",
+                         experiment)
+        self._metric("observe", "kft_swarm_claim_seconds", experiment, dt)
+        self._post_spans(ns, trial.name, rec.get("pod") or trial.name, [{
+            "name": "trial.claim", "t0": t0, "t1": t0 + dt,
+            "attrs": {"trial": trial.name, "warm": int(warm),
+                      "pod": rec.get("pod", "")}}])
+
+    # ------------------------------------------------------------- poll --
+
+    def poll(self, trial, experiment):
+        prev = trial.state
+        super().poll(trial, experiment)
+        if prev == TrialState.RUNNING and trial.is_finished():
+            if trial.state == TrialState.SUCCEEDED:
+                self.trials_succeeded += 1
+                self._metric("inc", "kft_swarm_trials_succeeded_total",
+                             experiment)
+            else:
+                self.trials_failed += 1
+            self._stash(trial, experiment)
+            self._release(trial, experiment)
+
+    def _release(self, trial, experiment) -> None:
+        """Finished trial: drop the job record so its gang reservation is
+        freed (forget -> remove_group -> slice release) and delete the
+        exited pods. kill() already releases through forget; without this
+        twin on the success/failure path every completed trial parks its
+        slice forever, and a swarm larger than the slice pool starves at
+        admission once the pool is exhausted. Terminal pods cannot be
+        reclaimed (reclaim requires phase=Running), so they are deleted —
+        deletion also drops their job-pod-name claim aliases, and the
+        pool replenishes standbys on its own clock."""
+        ns = experiment.namespace
+        job = self.jobs.get(ns, trial.name)
+        if job is None:
+            return
+        pods = self.jobs.cluster.list_pods(ns, _job_selector(job))
+        self.jobs.forget(ns, trial.name)
+        for pod in pods:
+            try:
+                self.jobs.cluster.delete_pod(pod.namespace, pod.name)
+            except Exception:
+                pass            # reaper/watcher race: already gone
+
+    # ------------------------------------------------------------- kill --
+
+    def kill(self, trial, experiment):
+        """Early-stop (or experiment-end) kill: reclaim the trial's
+        claimed pods back into the pool, delete only what cannot be
+        returned (cold fallbacks), and forget the job record first so no
+        reconcile pass runs elastic recovery against the returning pod."""
+        ns = experiment.namespace
+        job = self.jobs.get(ns, trial.name)
+        if job is None:
+            return
+        now = time.time()
+        self._post_spans(ns, trial.name,
+                         self.records.get(trial.name, {}).get("pod")
+                         or trial.name,
+                         [{"name": "trial.stopped", "t0": now, "t1": now,
+                           "attrs": {"trial": trial.name,
+                                     "state": trial.state.value}}])
+        self._stash(trial, experiment)
+        pods = self.jobs.cluster.list_pods(ns, _job_selector(job))
+        self.jobs.forget(ns, trial.name)
+        reclaimed = 0
+        for pod in pods:
+            if self.pool.reclaim(pod.namespace, pod.name):
+                reclaimed += 1
+            else:
+                try:
+                    self.jobs.cluster.delete_pod(pod.namespace, pod.name)
+                except Exception:
+                    pass            # reaper/reclaim race: already gone
+        self.trials_stopped += 1
+        self.reclaims += reclaimed
+        self.reclaim_noops += len(pods) - reclaimed
+        rec = self.records.setdefault(trial.name, {})
+        rec["reclaimed_pods"] = reclaimed
+        self._metric("inc", "kft_swarm_trials_stopped_total", experiment)
+        for _ in range(reclaimed):
+            self._metric("inc", "kft_swarm_reclaims_total", experiment)
+
+    # ---------------------------------------------------------- helpers --
+
+    def _stash(self, trial, experiment) -> None:
+        """Capture the operator-side trace/phases for a trial while its
+        job record still exists — kill() forgets the record, and the
+        operator prunes phase reports with it."""
+        if self.operator is None:
+            return
+        rec = self.records.setdefault(trial.name, {})
+        try:
+            rec["phases"] = self.operator.job_phases(
+                experiment.namespace, trial.name)
+            rec["trace"] = self.operator.job_trace(
+                experiment.namespace, trial.name)
+        except Exception:
+            pass
+
+    def _metric(self, kind: str, name: str, experiment,
+                value: float = 1.0) -> None:
+        op = self.operator
+        if op is None or getattr(op, "metrics", None) is None:
+            return
+        labels = {"experiment": experiment.name}
+        if kind == "observe":
+            op.metrics.observe(name, value, labels)
+        else:
+            op.metrics.inc(name, labels)
+
+    def _post_spans(self, ns: str, job_name: str, pod_name: str,
+                    spans: list) -> None:
+        op = self.operator
+        if op is None:
+            return
+        job = self.jobs.get(ns, job_name)
+        if job is None:
+            return
+        try:
+            op.heartbeat_post(ns, job_name, pod_name, {"spans": spans},
+                              uid=job.uid)
+        except Exception:
+            pass                    # spans are best-effort, like beats
+
+    def snapshot(self) -> dict:
+        return {
+            "trials_running": self.trials_running,
+            "trials_succeeded": self.trials_succeeded,
+            "trials_failed": self.trials_failed,
+            "trials_stopped": self.trials_stopped,
+            "warm_claims": self.warm_claims,
+            "pool_starvation": self.pool_starvation,
+            "reclaims": self.reclaims,
+            "reclaim_noops": self.reclaim_noops,
+        }
+
+
+def experiment_trace(runner: SwarmTrialRunner, experiment) -> list[dict]:
+    """The experiment-level merged trace: every trial's operator job
+    trace (stashed at terminal transition for killed/finished trials,
+    fetched live otherwise) folded into one span list — one Perfetto
+    document with a process row per trial pod. Write it with
+    ``obs.export.write_chrome_trace``."""
+    from kubeflow_tpu.obs.export import merge_spans
+
+    traces = []
+    for trial in experiment.trials:
+        rec = runner.records.get(trial.name, {})
+        spans = rec.get("trace")
+        if not spans and runner.operator is not None:
+            try:
+                spans = runner.operator.job_trace(
+                    experiment.namespace, trial.name)
+            except Exception:
+                spans = []
+        if spans:
+            traces.append(spans)
+    return merge_spans(*traces) if traces else []

@@ -38,6 +38,23 @@ HELP: dict[str, str] = {
         "Inter-token latency per generated token (chunk-amortized)",
     "kft_model_request_e2e_seconds":
         "End-to-end request latency (enqueue -> finish)",
+    # trial swarm (hpo/swarm.py SwarmTrialRunner + warm-pool reclaim arc)
+    "kft_swarm_trials_running_total":
+        "HPO trials that entered RUNNING (per experiment)",
+    "kft_swarm_trials_succeeded_total":
+        "HPO trials that finished with an objective value",
+    "kft_swarm_trials_stopped_total":
+        "HPO trials early-stopped/killed by the controller",
+    "kft_swarm_pool_starvation_total":
+        "Trials that cold-started because the warm pool was dry",
+    "kft_swarm_reclaims_total":
+        "Early-stopped trial pods returned to the warm pool as standbys",
+    "kft_swarm_claim_seconds":
+        "Trial submit -> worker exec latency (warm claim or cold path)",
+    "kft_warm_pool_reclaims_total":
+        "Claimed pods returned to standby (worker killed, token rotated)",
+    "kft_warm_pool_reclaim_noops_total":
+        "Reclaims of finished/dead/gone pods (counted no-op, never a crash)",
     # disaggregated serving (serving/disagg.py MigrationStats)
     "kft_disagg_migrations_total":
         "Completed prefill->decode paged-KV migrations",

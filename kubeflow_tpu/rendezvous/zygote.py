@@ -41,6 +41,17 @@ match is refused before any fork. The token lives in the pod spec, i.e.
 the same trust domain as the pod's ServiceAccount: reading it requires
 apiserver pod-read rights, which already imply claim rights. Deployments
 should ALSO scope a NetworkPolicy to the operator, defense in depth.
+
+RECLAIM (the warm-pool return arc): an early-stopped trial's pod goes
+BACK to the pool instead of being deleted. The controller sends
+``{"reclaim": true, "token": <current>, "new_token": <fresh>}``: the
+zygote SIGKILLs the live forked worker's process group (the child called
+setsid, so its pgid is its pid), ROTATES the accepted token, and acks
+``{"reclaimed": true, "killed": [...]}``. Token rotation is the fence
+that makes the returned pod safe to re-claim: a stale claimant replaying
+the old token — e.g. a late exec from the trial that was just stopped —
+is refused before any fork. The accept loop survives worker death, so
+the same resident zygote serves the next claim with imports still warm.
 """
 
 from __future__ import annotations
@@ -146,7 +157,12 @@ def serve(listen: str, announce_file: str | None = None) -> int:
         os.replace(tmp, announce_file)
     print(f"zygote ready on {bound}", flush=True)
 
-    token = os.environ.get("KFT_ZYGOTE_TOKEN", "")
+    # the accepted token is MUTABLE state (reclaim rotates it) and the
+    # forked-worker pids are tracked so a reclaim can kill them — both
+    # shared across handler threads behind one lock
+    state = {"token": os.environ.get("KFT_ZYGOTE_TOKEN", "")}
+    live_pids: set = set()
+    state_lock = threading.Lock()
 
     def handle(conn: socket.socket) -> None:
         try:
@@ -157,11 +173,36 @@ def serve(listen: str, announce_file: str | None = None) -> int:
                     return
                 buf += chunk
             req = json.loads(buf)
+            with state_lock:
+                token = state["token"]
             if token and req.get("token") != token:
-                # unauthenticated peer on the pod network: refuse BEFORE
-                # any fork (see module docstring, SECURITY)
+                # unauthenticated peer on the pod network — or a STALE
+                # claimant replaying a pre-reclaim token: refuse BEFORE
+                # any fork (see module docstring, SECURITY / RECLAIM)
                 conn.sendall(json.dumps(
                     {"error": "bad token"}).encode() + b"\n")
+                return
+            if req.get("reclaim"):
+                # warm-pool return arc: kill the live worker's process
+                # group and rotate the token BEFORE acking, so by the
+                # time the pod shows standby again the old trial cannot
+                # fork and the old token cannot exec
+                import signal
+
+                with state_lock:
+                    doomed = list(live_pids)
+                    if req.get("new_token"):
+                        state["token"] = str(req["new_token"])
+                killed = []
+                for pid in doomed:
+                    try:
+                        os.killpg(pid, signal.SIGKILL)
+                        killed.append(pid)
+                    except (ProcessLookupError, PermissionError):
+                        pass        # already gone: reclaim is idempotent
+                conn.sendall(json.dumps(
+                    {"reclaimed": True, "killed": killed}
+                ).encode() + b"\n")
                 return
             with _fork_lock:
                 pid = os.fork()
@@ -185,8 +226,12 @@ def serve(listen: str, announce_file: str | None = None) -> int:
 
                     traceback.print_exc()
                     os._exit(1)
+            with state_lock:
+                live_pids.add(pid)
             conn.sendall(json.dumps({"pid": pid}).encode() + b"\n")
             _, status = os.waitpid(pid, 0)
+            with state_lock:
+                live_pids.discard(pid)
             code = os.waitstatus_to_exitcode(status)
             try:
                 conn.sendall(json.dumps({"exit": code}).encode() + b"\n")

@@ -1,0 +1,722 @@
+"""Trial swarm (hpo/swarm.py + the warm-pool reclaim arc): shared-compile
+keying, reclaim races, suggestion determinism across restart, and the
+operator metric surface.
+
+The races here are the ones that corrupt a swarm silently: an early-stop
+kill racing trial completion (exactly one terminal outcome, never a pod
+wedged terminal-and-standby), a stale trial's late exec against a
+reclaimed pod (token fence), and a reclaim of a pod that is already dead
+or gone (counted no-op, never a crash)."""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.types import jax_job
+from kubeflow_tpu.controller import (
+    FakeKubeApiServer, JobController, KubeCluster, Operator,
+    WarmPoolController,
+)
+from kubeflow_tpu.controller.cluster import Pod, PodPhase
+from kubeflow_tpu.controller.kube import CLAIMED_AS_ANNOTATION
+from kubeflow_tpu.controller.warmpool import (
+    POOL_CLASS_LABEL, POOL_STATE_LABEL, ZYGOTE_ADDR_ANNOTATION,
+    ZYGOTE_TOKEN_ANNOTATION,
+)
+from kubeflow_tpu.hpo.controller import (
+    CallableTrialRunner, ExperimentController, JobTrialRunner,
+)
+from kubeflow_tpu.hpo.manager import ExperimentManager
+from kubeflow_tpu.hpo.persistence import ExperimentStore
+from kubeflow_tpu.hpo.swarm import SwarmTrialRunner, experiment_trace
+from kubeflow_tpu.hpo.types import (
+    AlgorithmSpec, Experiment, ObjectiveSpec, ParameterSpec, ParameterType,
+    Trial, TrialState,
+)
+from kubeflow_tpu.metadata.store import MetadataStore
+from kubeflow_tpu.obs.expo import validate_exposition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ZYGOTE_CMD = [sys.executable, "-m", "kubeflow_tpu.rendezvous.zygote",
+              "tcp://127.0.0.1:0"]
+WORKER_CMD = [sys.executable, "-m", "some.worker"]
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeKubeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def kube(apiserver):
+    return KubeCluster(apiserver.url)
+
+
+class ReclaimStub:
+    """Protocol-faithful zygote stand-in that ALSO speaks the reclaim
+    protocol: exec requests are token-checked and held open until either
+    the hold expires (worker "exits") or a reclaim kills them (exit -9
+    on the claim connection) and rotates the accepted token."""
+
+    def __init__(self, exit_code: int = 0, hold_s: float = 30.0,
+                 token: str = ""):
+        self.exit_code = exit_code
+        self.hold_s = hold_s
+        self.token = token          # "" = accept any (untokened standby)
+        self.requests: list[dict] = []
+        self._lock = threading.Lock()
+        self._live: list = []       # [(conn, kill_event)]
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.addr = "127.0.0.1:%d" % self._srv.getsockname()[1]
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _send(self, conn, obj):
+        try:
+            conn.sendall(json.dumps(obj).encode() + b"\n")
+        except OSError:
+            pass
+
+    def _handle(self, conn):
+        try:
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            req = json.loads(buf)
+            self.requests.append(req)
+            with self._lock:
+                if self.token and req.get("token") != self.token:
+                    self._send(conn, {"error": "bad token"})
+                    return
+                if req.get("reclaim"):
+                    if req.get("new_token"):
+                        self.token = str(req["new_token"])
+                    doomed, self._live = self._live, []
+                else:
+                    doomed = None
+            if doomed is not None:          # reclaim: kill live workers
+                for c, ev in doomed:
+                    ev.set()
+                    self._send(c, {"exit": -9})
+                    c.close()
+                self._send(conn, {"reclaimed": True,
+                                  "killed": [4242] * len(doomed)})
+                return
+            ev = threading.Event()
+            with self._lock:
+                self._live.append((conn, ev))
+            self._send(conn, {"pid": 4242})
+            if not ev.wait(self.hold_s):    # worker ran to completion
+                with self._lock:
+                    self._live = [(c, e) for c, e in self._live
+                                  if c is not conn]
+                self._send(conn, {"exit": self.exit_code})
+                conn.close()
+        except OSError:
+            pass
+
+    def close(self):
+        self._srv.close()
+
+
+def make_standby(kube, addr, name="kft-warm-default-0", token=""):
+    pod = Pod(name=name, namespace="default",
+              labels={POOL_CLASS_LABEL: "default",
+                      POOL_STATE_LABEL: "standby"},
+              env=({"KFT_ZYGOTE_TOKEN": token} if token else {}),
+              command=list(ZYGOTE_CMD), gang=False)
+    kube.create_pod(pod)
+    kube.set_phase("default", name, PodPhase.RUNNING)
+    kube.patch_pod("default", name, {"metadata": {"annotations": {
+        ZYGOTE_ADDR_ANNOTATION: addr}}})
+    return pod
+
+
+def job_pod(name="j-worker-0", job="j", uid="u1"):
+    return Pod(name=name, namespace="default",
+               labels={"job-name": job, "job-uid": uid,
+                       "replica-type": "Worker", "replica-index": "0"},
+               env={"KFT_PROCESS_ID": "0"},
+               command=list(WORKER_CMD), gang=True)
+
+
+def pod_doc(kube, name):
+    return kube._request("GET", kube._pod_path("default", name))
+
+
+# ---------------------------------------------------- shared compile keys --
+
+def swarm_params():
+    return [
+        ParameterSpec(name="lr", type=ParameterType.DOUBLE,
+                      min=1e-4, max=0.5, log=True),
+        ParameterSpec(name="width", type=ParameterType.CATEGORICAL,
+                      values=[8, 16]),
+    ]
+
+
+def test_scalar_trials_share_fingerprint_structural_fork():
+    """The shared-compile contract: two trials differing only in SCALAR
+    hyperparameters (lr/wd are traced arguments) lower to identical HLO
+    and the same depot key; a structural change (width) forks the key."""
+    from kubeflow_tpu.hpo.trial_worker import lowered_step
+    from kubeflow_tpu.parallel.depot import fingerprint
+
+    def key(width, depth):
+        return fingerprint(lowered_step(width, depth).as_text(),
+                           extra=(f"width={width}", f"depth={depth}"),
+                           stage="hpo-trial")
+
+    assert key(8, 2) == key(8, 2)        # scalars never enter the key
+    assert key(8, 2) != key(16, 2)       # width forks it
+    assert key(8, 2) != key(8, 4)        # depth forks it
+
+
+def test_shared_compile_one_publish_then_hits(tmp_path):
+    """N trials of one structural config against one depot: the first
+    publishes, every follower is a hit — and a different structural
+    config publishes its OWN entry, never colliding."""
+    from kubeflow_tpu.hpo.trial_worker import lowered_step
+    from kubeflow_tpu.parallel.depot import (
+        DepotStats, DirectoryDepot, load_or_compile,
+    )
+
+    depot = DirectoryDepot(str(tmp_path / "depot"))
+    stats = DepotStats()
+    _, out0 = load_or_compile(lowered_step(8, 2), depot,
+                              extra=("width=8", "depth=2"),
+                              stage="hpo-trial", stats=stats)
+    assert out0 == "published"
+    outcomes = [load_or_compile(lowered_step(8, 2), depot,
+                                extra=("width=8", "depth=2"),
+                                stage="hpo-trial", stats=stats,
+                                wait_s=5.0)[1]
+                for _ in range(3)]
+    assert outcomes == ["hit"] * 3, outcomes
+    # a structurally different trial forks the key: second publish,
+    # two distinct entries, no collision
+    _, out1 = load_or_compile(lowered_step(16, 2), depot,
+                              extra=("width=16", "depth=2"),
+                              stage="hpo-trial", stats=stats)
+    assert out1 == "published"
+    assert len(depot.keys()) == 2
+
+
+# --------------------------------------------------------- reclaim races --
+
+def test_reclaim_returns_pod_to_standby_and_reclaimable(kube):
+    """The full arc: claimed → running → reclaimed → claimable. After the
+    reclaim the pod is standby with pool-only labels, a fresh token
+    annotation, no claimed-as alias — and the NEXT job claims it warm
+    with the rotated token."""
+    stub = ReclaimStub(hold_s=30.0)
+    make_standby(kube, stub.addr)
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    claimed = pool.claim_and_exec(job_pod(name="t1-worker-0", job="t1",
+                                          uid="u1"))
+    assert claimed is not None and pool.claims == 1
+
+    assert pool.reclaim("default", claimed.name) is True
+    assert pool.reclaims == 1 and pool.reclaim_noops == 0
+    doc = pod_doc(kube, claimed.name)
+    labels = doc["metadata"]["labels"]
+    ann = doc["metadata"]["annotations"]
+    assert labels[POOL_STATE_LABEL] == "standby"
+    assert "job-name" not in labels and "job-uid" not in labels
+    assert CLAIMED_AS_ANNOTATION not in ann
+    rotated = ann[ZYGOTE_TOKEN_ANNOTATION]
+    assert rotated and stub.token == rotated
+    assert doc["status"]["phase"] == "Running"   # never went terminal
+    # the job-pod alias was released without deleting the pod
+    assert kube.get_pod("default", "t1-worker-0") is None
+    assert kube.get_pod("default", claimed.name) is not None
+
+    # re-claim by the next trial: the rotated token travels the exec
+    again = pool.claim_and_exec(job_pod(name="t2-worker-0", job="t2",
+                                        uid="u2"))
+    assert again is not None and again.name == claimed.name
+    assert pool.claims == 2
+    execs = [r for r in stub.requests if not r.get("reclaim")]
+    assert execs[-1]["token"] == rotated
+
+
+def test_reclaim_vs_completion_exactly_one_terminal_state(kube):
+    """Completion wins: the worker exits before the reclaim — the pod is
+    terminal (Succeeded) and the reclaim is a counted no-op that does NOT
+    resurrect it into the pool."""
+    stub = ReclaimStub(exit_code=0, hold_s=0.05)
+    make_standby(kube, stub.addr)
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    claimed = pool.claim_and_exec(job_pod())
+    assert claimed is not None
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        pod = kube.get_pod("default", claimed.name)
+        if pod is not None and pod.phase == PodPhase.SUCCEEDED:
+            break
+        time.sleep(0.02)
+    assert kube.get_pod("default", claimed.name).phase == PodPhase.SUCCEEDED
+
+    assert pool.reclaim("default", claimed.name) is False
+    assert pool.reclaim_noops == 1 and pool.reclaims == 0
+    doc = pod_doc(kube, claimed.name)
+    assert doc["status"]["phase"] == "Succeeded"          # stayed terminal
+    assert doc["metadata"]["labels"][POOL_STATE_LABEL] == "claimed"
+
+
+def test_reclaim_wins_late_exit_report_suppressed(kube):
+    """Reclaim wins: the disarmed watcher must swallow the {"exit": -9}
+    the zygote reports for the killed worker — a terminal PATCH after the
+    reclaim would wedge the returned standby forever (terminal-wins)."""
+    stub = ReclaimStub(hold_s=30.0)
+    make_standby(kube, stub.addr)
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    claimed = pool.claim_and_exec(job_pod())
+    assert claimed is not None
+    watcher = pool._watchers[("default", claimed.name)]
+
+    assert pool.reclaim("default", claimed.name) is True
+    watcher.join(timeout=10)        # it read the kill's exit report
+    assert not watcher.is_alive()
+    doc = pod_doc(kube, claimed.name)
+    assert doc["status"]["phase"] == "Running", (
+        "disarmed watcher still reported the reclaim kill as terminal")
+    assert doc["metadata"]["labels"][POOL_STATE_LABEL] == "standby"
+    # and it is genuinely claimable again
+    assert pool.claimable() == 1
+
+
+def test_reclaim_token_fence_refuses_stale_exec(kube):
+    """A stale claimant (the stopped trial's late exec) replaying the OLD
+    token after a reclaim is refused; the new claimant holds the rotated
+    token from the annotation and is accepted."""
+    stub = ReclaimStub(hold_s=30.0, token="tok-original")
+    make_standby(kube, stub.addr, token="tok-original")
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    claimed = pool.claim_and_exec(job_pod(name="t1-worker-0", job="t1"))
+    assert claimed is not None
+    assert stub.requests[0]["token"] == "tok-original"
+
+    assert pool.reclaim("default", claimed.name) is True
+    rotated = stub.token
+    assert rotated != "tok-original"
+
+    # the stale trial's late exec: old token, refused before any fork
+    stale = pool._exec(stub.addr, claimed, WORKER_CMD, {},
+                       token="tok-original")
+    assert stale is None
+    assert pool.claimable() == 1    # the refusal cost the pool nothing
+
+    again = pool.claim_and_exec(job_pod(name="t2-worker-0", job="t2",
+                                        uid="u2"))
+    assert again is not None and again.name == claimed.name
+    execs = [r for r in stub.requests if not r.get("reclaim")]
+    assert execs[-1]["token"] == rotated
+
+
+def test_reclaim_of_dead_or_gone_pod_is_counted_noop(kube):
+    """Reclaims that cannot succeed are COUNTED no-ops, never crashes:
+    a pod that does not exist, an unclaimed standby, and a claimed pod
+    whose zygote died (which is additionally failed + reaped so the
+    reconcile loop replenishes)."""
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD,
+                              dial_timeout_s=0.5)
+    assert pool.reclaim("default", "no-such-pod") is False
+    assert pool.reclaim_noops == 1
+
+    stub = ReclaimStub(hold_s=30.0)
+    make_standby(kube, stub.addr)
+    assert pool.reclaim("default", "kft-warm-default-0") is False
+    assert pool.reclaim_noops == 2          # standby, not claimed: not ours
+
+    claimed = pool.claim_and_exec(job_pod())
+    assert claimed is not None
+    # the zygote dies under the claim: its announced address now refuses
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_addr = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    kube.patch_pod("default", claimed.name, {"metadata": {"annotations": {
+        ZYGOTE_ADDR_ANNOTATION: dead_addr}}})
+    assert pool.reclaim("default", claimed.name) is False
+    assert pool.reclaim_noops == 3
+    # the corpse was made visible and reaped; replenish covers the hole
+    assert kube.get_pod("default", claimed.name) is None
+    assert pool.reaped == 1
+    pool.reconcile()
+    assert pool.standby_count() == 1
+
+
+def test_concurrent_reclaim_and_completion_converge(kube):
+    """The adversarial schedule: reclaim racing the worker's own exit at
+    the same instant. Whatever interleaving happens, the pod ends in
+    EXACTLY one of the two legal states — terminal Succeeded (completion
+    won, reclaim no-oped) or Running standby (reclaim won, exit report
+    suppressed) — and the counters agree with the outcome."""
+    for round_i in range(4):
+        stub = ReclaimStub(exit_code=0, hold_s=0.05)
+        name = f"kft-race-{round_i}"
+        make_standby(kube, stub.addr, name=name)
+        pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+        claimed = pool.claim_and_exec(job_pod(
+            name=f"r{round_i}-worker-0", job=f"r{round_i}",
+            uid=f"ru{round_i}"))
+        assert claimed is not None
+        time.sleep(0.03)                    # land near the exit report
+        won = pool.reclaim("default", claimed.name)
+        # let any in-flight watcher report drain
+        watcher = pool._watchers.get(("default", claimed.name))
+        if watcher is not None:
+            watcher.join(timeout=10)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            doc = pod_doc(kube, claimed.name)
+            phase = doc["status"]["phase"]
+            state = doc["metadata"]["labels"][POOL_STATE_LABEL]
+            if won and state == "standby":
+                break
+            if not won and phase == "Succeeded":
+                break
+            time.sleep(0.02)
+        if won:
+            assert state == "standby" and phase == "Running", (
+                round_i, won, phase, state)
+            assert pool.reclaims == 1
+        else:
+            assert phase == "Succeeded" and state == "claimed", (
+                round_i, won, phase, state)
+            assert pool.reclaim_noops == 1
+        # round isolation: a leftover standby must not be claimed by the
+        # NEXT round (its stub is about to close)
+        kube.delete_pod("default", claimed.name)
+        stub.close()
+
+
+# ------------------------------------------------- swarm runner (stubbed) --
+
+def swarm_experiment(name="swarm", **kw):
+    kw.setdefault("parallel_trial_count", 1)
+    kw.setdefault("max_trial_count", 4)
+    return Experiment(
+        name=name, parameters=swarm_params(),
+        algorithm=AlgorithmSpec(name="random", settings={"seed": 7}),
+        objective=ObjectiveSpec(metric_name="loss"), **kw)
+
+
+def trial_template(trial_name, params):
+    job = jax_job(trial_name, workers=1, mesh={"data": 1},
+                  command=list(WORKER_CMD))
+    job.replica_specs["Worker"].template.env.update(
+        {"KFT_TRIAL_LR": str(params.get("lr", 0.1)),
+         "KFT_TRIAL_WIDTH": str(params.get("width", 8))})
+    return job
+
+
+def test_swarm_publisher_follower_designation(kube, tmp_path):
+    """First trial per structural config compiles+publishes; every later
+    one of the SAME config is a follower (KFT_DEPOT_WAIT_S set); a new
+    structural config designates its own publisher."""
+    runner = SwarmTrialRunner(JobController(kube), trial_template,
+                              str(tmp_path / "m"), pool=None,
+                              structural_keys=("width",))
+    exp = swarm_experiment()
+    jobs = {}
+    for i, params in enumerate([{"lr": 0.1, "width": 8},
+                                {"lr": 0.2, "width": 8},
+                                {"lr": 0.1, "width": 16}]):
+        t = Trial(name=f"t{i}", parameters=params)
+        jobs[i] = trial_template(t.name, params)
+        runner._prepare_job(jobs[i], t, exp)
+
+    env = lambda i: jobs[i].replica_specs["Worker"].template.env
+    assert "KFT_DEPOT_WAIT_S" not in env(0)      # width=8 publisher
+    assert "KFT_DEPOT_WAIT_S" in env(1)          # width=8 follower
+    assert "KFT_DEPOT_WAIT_S" not in env(2)      # width=16 publisher
+    assert runner.records["t0"]["structural"] == (("width", "8"),)
+    assert runner.records["t2"]["structural"] == (("width", "16"),)
+
+
+def test_swarm_failed_publisher_undesignates(kube, tmp_path, monkeypatch):
+    """A designated publisher whose admission is REJECTED must release
+    the designation — otherwise every follower of that structural config
+    waits for a publish that never comes."""
+    ctl = JobController(kube)
+    runner = SwarmTrialRunner(ctl, trial_template, str(tmp_path / "m"),
+                              pool=None, structural_keys=("width",))
+    exp = swarm_experiment()
+    monkeypatch.setattr(ctl, "submit",
+                        lambda job: (_ for _ in ()).throw(
+                            ValueError("quota")))
+    t0 = Trial(name="t0", parameters={"lr": 0.1, "width": 8})
+    runner.start(t0, exp)
+    assert t0.state == TrialState.FAILED
+    assert runner.trials_failed == 1
+    assert (("width", "8"),) not in runner._publishers
+    monkeypatch.undo()
+    # the NEXT trial of that config becomes the publisher, not a follower
+    t1 = Trial(name="t1", parameters={"lr": 0.2, "width": 8})
+    job = trial_template(t1.name, t1.parameters)
+    runner._prepare_job(job, t1, exp)
+    assert not runner.records["t1"]["follower"]
+
+
+def test_swarm_kill_reclaims_and_next_trial_reclaims_pod(kube, tmp_path):
+    """The swarm arc end-to-end over stub zygotes: a trial claims warm,
+    an early-stop kill RETURNS the pod to the pool (job forgotten first,
+    pod never deleted), and the next trial claims the same pod again."""
+    stub = ReclaimStub(hold_s=30.0)
+    make_standby(kube, stub.addr)
+    pool = WarmPoolController(kube, size=1, command=ZYGOTE_CMD)
+    kube.warm_pool = pool
+    ctl = JobController(kube)
+    runner = SwarmTrialRunner(ctl, trial_template, str(tmp_path / "m"),
+                              pool=pool, structural_keys=("width",))
+    exp = swarm_experiment()
+
+    t1 = Trial(name="sw-trial-1", parameters={"lr": 0.1, "width": 8})
+    runner.start(t1, exp)
+    assert t1.state == TrialState.RUNNING
+    assert runner.warm_claims == 1 and runner.pool_starvation == 0
+    assert runner.records["sw-trial-1"]["warm"]
+    assert runner.records["sw-trial-1"]["pod"] == "kft-warm-default-0"
+
+    t1.state = TrialState.EARLY_STOPPED       # controller settles state
+    runner.kill(t1, exp)
+    assert runner.trials_stopped == 1 and runner.reclaims == 1
+    assert runner.records["sw-trial-1"]["reclaimed_pods"] == 1
+    assert ctl.get("default", "sw-trial-1") is None   # forgotten, not run
+    doc = pod_doc(kube, "kft-warm-default-0")         # pod survived, standby
+    assert doc["metadata"]["labels"][POOL_STATE_LABEL] == "standby"
+
+    t2 = Trial(name="sw-trial-2", parameters={"lr": 0.2, "width": 8})
+    runner.start(t2, exp)
+    assert t2.state == TrialState.RUNNING
+    assert runner.warm_claims == 2
+    assert runner.records["sw-trial-2"]["pod"] == "kft-warm-default-0"
+    snap = runner.snapshot()
+    assert snap["reclaims"] == 1 and snap["reclaim_noops"] == 0
+    stub.close()
+
+
+def test_swarm_dry_pool_counts_starvation(kube, tmp_path):
+    """A dry pool cold-falls-back and the starvation is COUNTED — the
+    replenish-rate signal, not a silent slow path."""
+    pool = WarmPoolController(kube, size=0, command=ZYGOTE_CMD)
+    kube.warm_pool = pool
+    runner = SwarmTrialRunner(JobController(kube), trial_template,
+                              str(tmp_path / "m"), pool=pool,
+                              structural_keys=("width",))
+    exp = swarm_experiment()
+    t = Trial(name="cold-trial-1", parameters={"lr": 0.1, "width": 8})
+    runner.start(t, exp)
+    assert t.state == TrialState.RUNNING
+    assert runner.pool_starvation == 1 and runner.warm_claims == 0
+    assert not runner.records["cold-trial-1"]["warm"]
+
+
+# ------------------------------------------- suggestion determinism (c) --
+
+def seeded_exp(name, seed=13, n=6):
+    return Experiment(
+        name=name,
+        parameters=[ParameterSpec(name="x", type=ParameterType.DOUBLE,
+                                  min=0.0, max=1.0)],
+        algorithm=AlgorithmSpec(name="random", settings={"seed": seed}),
+        objective=ObjectiveSpec(metric_name="loss"),
+        max_trial_count=n, parallel_trial_count=1,
+        max_failed_trial_count=3)
+
+
+def test_suggestion_determinism_across_restart(tmp_path):
+    """Same Experiment seed → same suggestion sequence, across a
+    controller restart mid-sweep: the resumed experiment fast-forwards
+    the algorithm cursor, re-runs NO completed trial, and the combined
+    parameter sequence equals the uninterrupted seeded run's."""
+    calls_a = []
+
+    def obj_a(params, report):
+        calls_a.append(params["x"])
+        return (params["x"] - 0.3) ** 2
+
+    ra = CallableTrialRunner(obj_a, max_workers=1)
+    ea = seeded_exp("uninterrupted")
+    ExperimentController(ea, ra).run(timeout=60.0)
+    ra.shutdown()
+    expected = [float(t.parameters["x"]) for t in ea.trials]
+    assert len(expected) == 6
+
+    wal = str(tmp_path / "md.wal")
+    store = ExperimentStore(MetadataStore(wal_path=wal))
+    calls_b = []
+
+    def obj_b(params, report):
+        calls_b.append(params["x"])
+        return (params["x"] - 0.3) ** 2
+
+    rb = CallableTrialRunner(obj_b, max_workers=1)
+    eb = seeded_exp("resumed")
+    ctl = ExperimentController(eb, rb, store=store)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        ctl.step()
+        if sum(t.is_finished() for t in eb.trials) >= 3:
+            break
+        time.sleep(0.01)
+    rb.shutdown()                               # "crash"
+    assert len(calls_b) >= 3 and not eb.succeeded
+
+    calls_c = []
+
+    def obj_c(params, report):
+        calls_c.append(params["x"])
+        return (params["x"] - 0.3) ** 2
+
+    rc = CallableTrialRunner(obj_c, max_workers=1)
+    store2 = ExperimentStore(MetadataStore(wal_path=wal))
+    ctl2 = ExperimentController.resume("default", "resumed", rc, store2)
+    out = ctl2.run(timeout=60.0)
+    rc.shutdown()
+    assert out.succeeded
+    # completed trials were NOT re-run: the resumed runner only executed
+    # the remainder of the sweep
+    assert len(calls_c) == len(out.trials) - len(eb.trials), (
+        calls_b, calls_c)
+    # and the full parameter sequence is the seeded sequence, exactly
+    got = [float(t.parameters["x"]) for t in out.trials]
+    assert got == pytest.approx(expected)
+    # the pre-crash trials kept their terminal state and objective
+    by_name = {t.name: t for t in out.trials}
+    for t in eb.trials:
+        if t.state == TrialState.SUCCEEDED:
+            assert by_name[t.name].state == TrialState.SUCCEEDED
+            assert by_name[t.name].objective_value == pytest.approx(
+                t.objective_value)
+
+
+def test_same_seed_same_sequence_fresh_controllers():
+    """Two controllers over two equal-seeded experiments draw the same
+    assignments; a different seed draws a different sequence."""
+
+    def run(name, seed):
+        r = CallableTrialRunner(lambda p, rep: p["x"] ** 2, max_workers=1)
+        e = seeded_exp(name, seed=seed, n=4)
+        ExperimentController(e, r).run(timeout=60.0)
+        r.shutdown()
+        return [float(t.parameters["x"]) for t in e.trials]
+
+    assert run("s1", 42) == pytest.approx(run("s2", 42))
+    assert run("s3", 42) != pytest.approx(run("s4", 43))
+
+
+# ------------------------------------------------ manager/operator wiring --
+
+def test_manager_dispatches_swarm_runner(kube, tmp_path):
+    ctl = JobController(kube)
+    pool = WarmPoolController(kube, size=0, command=ZYGOTE_CMD)
+    mgr = ExperimentManager(ctl, str(tmp_path / "m"), swarm_pool=pool,
+                            structural_keys=("width",))
+    r = mgr._runner("name: ${trial}\n")
+    assert isinstance(r, SwarmTrialRunner)
+    assert r.pool is pool and r.structural_keys == ("width",)
+    plain = ExperimentManager(ctl, str(tmp_path / "m2"))
+    assert type(plain._runner("name: x\n")) is JobTrialRunner
+
+
+def test_operator_attaches_itself_to_swarm_manager(kube, tmp_path):
+    ctl = JobController(kube)
+    pool = WarmPoolController(kube, size=0, command=ZYGOTE_CMD)
+    mgr = ExperimentManager(ctl, str(tmp_path / "m"), swarm_pool=pool)
+    op = Operator(ctl, experiment_manager=mgr, reconcile_slow_period=5.0,
+                  warm_pool=pool)
+    try:
+        assert mgr.operator is op
+        r = mgr._runner("name: x\n")
+        assert isinstance(r, SwarmTrialRunner) and r.operator is op
+    finally:
+        op.stop()
+
+
+def test_swarm_metrics_render_and_lint(kube, tmp_path):
+    """The kft_swarm_* family renders through the shared exposition
+    helper and passes the repo's own lint — counter/histogram suffix
+    rules, HELP/TYPE headers, cumulative buckets."""
+    ctl = JobController(kube)
+    pool = WarmPoolController(kube, size=0, command=ZYGOTE_CMD)
+    mgr = ExperimentManager(ctl, str(tmp_path / "m"), swarm_pool=pool,
+                            structural_keys=("width",))
+    op = Operator(ctl, experiment_manager=mgr, reconcile_slow_period=5.0,
+                  warm_pool=pool)
+    try:
+        runner = mgr._runner("name: x\n")
+        exp = swarm_experiment("lint-exp")
+        for name, v in [("kft_swarm_trials_running_total", None),
+                        ("kft_swarm_trials_succeeded_total", None),
+                        ("kft_swarm_trials_stopped_total", None),
+                        ("kft_swarm_pool_starvation_total", None),
+                        ("kft_swarm_reclaims_total", None)]:
+            runner._metric("inc", name, exp)
+        runner._metric("observe", "kft_swarm_claim_seconds", exp, 0.25)
+        pool.reclaims, pool.reclaim_noops = 2, 1
+        op._tick_warm_pool()
+        text = op.metrics.render()
+        for fam in ("kft_swarm_trials_running_total",
+                    "kft_swarm_trials_stopped_total",
+                    "kft_swarm_pool_starvation_total",
+                    "kft_swarm_reclaims_total",
+                    "kft_swarm_claim_seconds_bucket",
+                    "kft_warm_pool_reclaims_total",
+                    "kft_warm_pool_reclaim_noops_total"):
+            assert fam in text, f"{fam} missing from exposition"
+        assert 'experiment="lint-exp"' in text
+        problems = validate_exposition(text)
+        assert problems == [], problems
+    finally:
+        op.stop()
+
+
+def test_experiment_trace_merges_trial_traces(kube, tmp_path):
+    """experiment_trace folds stashed per-trial traces into one valid
+    Perfetto-loadable span list."""
+    from kubeflow_tpu.obs.export import chrome_trace, validate_trace
+
+    runner = SwarmTrialRunner(JobController(kube), trial_template,
+                              str(tmp_path / "m"), pool=None)
+    exp = swarm_experiment("trace-exp")
+    t0 = time.time()
+    for i in range(2):
+        t = Trial(name=f"tr-{i}", parameters={"lr": 0.1, "width": 8})
+        exp.trials.append(t)
+        runner.records[t.name] = {"trace": [
+            {"name": "trial.load", "t0": t0, "t1": t0 + 0.5,
+             "proc": t.name},
+            {"name": "trial.step", "t0": t0 + 0.5, "t1": t0 + 0.6,
+             "proc": t.name},
+        ]}
+    spans = experiment_trace(runner, exp)
+    assert len(spans) == 4
+    assert validate_trace(spans) == []
+    doc = chrome_trace(spans)
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(events) == 4
+    # one Perfetto process row per trial pod
+    assert len({e["pid"] for e in events}) == 2
