@@ -242,6 +242,7 @@ def pipeline_jax_job(
     *,
     stages: int,
     workers_per_stage: int = 1,
+    virtual_stages: int = 1,
     tpu: TPUSpec | None = None,
     image: str = "kubeflow-tpu/runtime:latest",
     command: list[str] | None = None,
@@ -257,11 +258,20 @@ def pipeline_jax_job(
     by one stable Service per stage) next to the usual JAXJob contract;
     ``rendezvous.bootstrap.stage_from_env`` reads it in-worker. A dead
     stage worker takes the per-worker replacement path (PR 9) — the
-    stage Services keep the neighbor addresses valid across it."""
+    stage Services keep the neighbor addresses valid across it.
+
+    ``virtual_stages`` > 1 requests the interleaved-1F1B schedule: each
+    worker owns V model chunks and the controller additionally stamps
+    KFT_VIRTUAL_STAGES plus the ring-wrap links (KFT_STAGE_WRAP_NEXT on
+    the last stage, KFT_STAGE_WRAP_PREV on stage 0)."""
     if stages < 2:
         raise ValidationError("pipeline_jax_job needs stages >= 2")
+    if virtual_stages < 1:
+        raise ValidationError("pipeline_jax_job needs virtual_stages >= 1")
     env = dict(env or {})
     env["KFT_NUM_STAGES"] = str(stages)
+    if virtual_stages > 1:
+        env["KFT_VIRTUAL_STAGES"] = str(virtual_stages)
     return jax_job(
         name, workers=stages * workers_per_stage, tpu=tpu, image=image,
         command=command, env=env, run_policy=run_policy,

@@ -416,6 +416,14 @@ class JobController:
                 env["KFT_STAGE_ID"] = str(sid)
                 env["KFT_STAGE_WORKERS"] = str(wps)
                 env["KFT_STAGE_PROC_ID"] = str(index % wps)
+                # per-stage worker-group identity: the rendezvous triplet
+                # of the (future) per-stage jax.distributed world. Rank 0
+                # of each group is that world's coordinator, addressed by
+                # the stage service.
+                env["KFT_STAGE_GROUP_SIZE"] = str(wps)
+                env["KFT_STAGE_GROUP_RANK"] = str(index % wps)
+                env["KFT_STAGE_GROUP_COORD"] = self.cluster.resolve(
+                    job.namespace, _stage_service_name(job, sid))
                 env["KFT_STAGE_BIND"] = self.cluster.resolve(
                     job.namespace, _stage_service_name(job, sid))
                 if sid > 0:
@@ -424,6 +432,19 @@ class JobController:
                 if sid < stages - 1:
                     env["KFT_STAGE_NEXT"] = self.cluster.resolve(
                         job.namespace, _stage_service_name(job, sid + 1))
+                # interleaved-1F1B: when the template asks for V>1 virtual
+                # stages the chunk graph wraps around the worker ring —
+                # the last stage forwards activations to stage 0's next
+                # chunk, and stage 0 returns grads to the last stage.
+                vstages = int(spec.template.env.get("KFT_VIRTUAL_STAGES", "1"))
+                if vstages > 1:
+                    env["KFT_VIRTUAL_STAGES"] = str(vstages)
+                    if sid == stages - 1:
+                        env["KFT_STAGE_WRAP_NEXT"] = self.cluster.resolve(
+                            job.namespace, _stage_service_name(job, 0))
+                    if sid == 0:
+                        env["KFT_STAGE_WRAP_PREV"] = self.cluster.resolve(
+                            job.namespace, _stage_service_name(job, stages - 1))
             if spec.template.tpu is not None:
                 tpu = spec.template.tpu
                 env["KFT_TPU_ACCELERATOR"] = tpu.accelerator

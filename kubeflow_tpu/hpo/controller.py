@@ -212,12 +212,29 @@ class ExperimentController:
 
     def __init__(self, experiment: Experiment, runner: TrialRunner,
                  core: Optional[SuggestionCore] = None, store=None,
-                 trial_seq: int = 0):
+                 trial_seq: int = 0, suggestion_batch: int = 0):
         experiment.validate()
         self.exp = experiment
         self.runner = runner
         self.core = core or SuggestionCore()
         self.core.register(experiment)
+        # suggestion batching (ROADMAP 4c): at 100+ parallel trials the
+        # trickle of completions would otherwise cost one count=1
+        # get_suggestions per launch pass. With suggestion_batch > 0 each
+        # draw requests max(budget, suggestion_batch) and the surplus is
+        # buffered, so calls amortize to ~launched/batch. Buffered
+        # assignments are DELIBERATELY not persisted: on restart the
+        # resume() fast-forward replays only the LAUNCHED prefix, so a
+        # fresh cursor re-derives the exact buffered sequence —
+        # determinism across restart costs nothing. Default 0 keeps the
+        # draw-exactly-budget behavior (right for history-conditioned
+        # algorithms like TPE/CMA-ES, which want maximal history per
+        # draw).
+        self.suggestion_batch = suggestion_batch
+        self._suggestion_buf: list[dict] = []
+        self._search_exhausted = False
+        self.suggestion_calls = 0
+        self.max_calls_per_pass = 0
         self.stopper = make_stopper(experiment.objective,
                                     experiment.early_stopping)
         # trial_seq is passed on resume so the initial sync below never
@@ -232,7 +249,8 @@ class ExperimentController:
 
     @classmethod
     def resume(cls, namespace: str, name: str, runner: TrialRunner, store,
-               core: Optional[SuggestionCore] = None) -> "ExperimentController":
+               core: Optional[SuggestionCore] = None,
+               suggestion_batch: int = 0) -> "ExperimentController":
         """Reconstruct a controller from the metadata store after a daemon
         restart. In-flight trials died with the previous process and are
         marked KILLED (not FAILED: a crash of the *operator* must not eat
@@ -247,10 +265,15 @@ class ExperimentController:
             if not t.is_finished():
                 t.state = TrialState.KILLED
                 t.completion_time = time.time()
-        ctl = cls(exp, runner, core, store=store, trial_seq=seq)
+        ctl = cls(exp, runner, core, store=store, trial_seq=seq,
+                  suggestion_batch=suggestion_batch)
         if exp.trials and not (exp.succeeded or exp.failed):
             # consume (and discard) as many suggestions as were previously
-            # issued so grid/sobol cursors do not replay duplicates
+            # LAUNCHED so grid/sobol cursors do not replay duplicates.
+            # Suggestions that were only buffered (suggestion_batch
+            # prefetch) were never persisted, so the fresh cursor
+            # re-derives them next draw — the launched prefix is the
+            # whole replay state
             ctl.core.get_suggestions(exp.name, len(exp.trials))
         return ctl
 
@@ -307,7 +330,22 @@ class ExperimentController:
         budget = min(exp.parallel_trial_count - running,
                      exp.max_trial_count - launched)
         if budget > 0:
-            suggestions = self.core.get_suggestions(exp.name, budget)
+            calls = 0
+            if len(self._suggestion_buf) < budget \
+                    and not self._search_exhausted:
+                want = max(budget, self.suggestion_batch) \
+                    - len(self._suggestion_buf)
+                got = self.core.get_suggestions(exp.name, want)
+                calls += 1
+                self.suggestion_calls += 1
+                if len(got) < want:
+                    # a short draw means a finite space (e.g. grid) is
+                    # fully enumerated — never ask again
+                    self._search_exhausted = True
+                self._suggestion_buf.extend(got)
+            self.max_calls_per_pass = max(self.max_calls_per_pass, calls)
+            suggestions = self._suggestion_buf[:budget]
+            del self._suggestion_buf[:budget]
             if not suggestions and running == 0 and finished == launched:
                 # finite search space (e.g. grid) enumerated before
                 # max_trial_count: the experiment is done, not stuck

@@ -50,6 +50,11 @@ class SuggestionCore:
         self._experiments: dict[str, Experiment] = {}
         self.observations = ObservationLog()
         self._lock = threading.Lock()
+        # service-side amortization counters (ROADMAP 4c): at 100+
+        # parallel trials the controller must batch its draws —
+        # served_total/calls_total is the measured amortization factor
+        self.calls_total = 0
+        self.served_total = 0
 
     def register(self, exp: Experiment) -> None:
         with self._lock:
@@ -64,8 +69,16 @@ class SuggestionCore:
         with self._lock:
             algo = self._algos[experiment]
             exp = self._experiments[experiment]
-            return algo.suggest(
+            out = algo.suggest(
                 trials if trials is not None else exp.trials, count)
+            self.calls_total += 1
+            self.served_total += len(out)
+            return out
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"calls_total": self.calls_total,
+                    "served_total": self.served_total}
 
     # -- wire dispatch ------------------------------------------------------
     def handle(self, req: dict[str, Any]) -> dict[str, Any]:

@@ -287,9 +287,18 @@ def build_job_trace(namespace: str, name: str, uid: str,
                                proc="operator"))
     for pod, posted in sorted((worker_spans or {}).items()):
         for s in posted:
-            spans.append(_span(
+            attrs = dict(s.get("attrs") or {})
+            span = _span(
                 s.get("name", "worker.span"), trace_id, s["t0"], s["t1"],
                 parent=pod_roots.get(pod, root["span_id"]),
-                attrs=dict(s.get("attrs") or {}), proc=f"worker:{pod}"))
+                attrs=attrs, proc=f"worker:{pod}")
+            # interleaved-1F1B: a stage worker multiplexes V virtual
+            # chunks; give each chunk its own thread lane so the Perfetto
+            # view shows the interleave instead of one flattened track
+            try:
+                span["tid"] = int(attrs.get("vstage", 0))
+            except (TypeError, ValueError):
+                pass
+            spans.append(span)
     spans.sort(key=lambda s: s["t0"])
     return spans

@@ -103,6 +103,14 @@ class ScaleProof:
     coll_bubble_s: float = 0.0
     # est_mfu restated against the BASELINE >=0.40 target (>1 = margin)
     margin_vs_target: float = 0.0
+    # MPMD pipeline projection (filled when the bench hands a MEASURED
+    # interleaved bubble to scale_proofs): the measured bubble rescaled
+    # to the target stage/microbatch/virtual-stage shape by the ratio of
+    # analytic fill/drain bounds, then folded into est_mfu
+    pipe_bubble_measured: float = 0.0
+    pipe_bubble_projected: float = 0.0
+    pipe_mfu: float = 0.0
+    pipe_basis: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -468,6 +476,66 @@ def _estimate_roofline(proof: ScaleProof, compiled, kind: str,
         + "est_mfu restated vs the 0.40 target as margin_vs_target")
 
 
+# ------------------------------------------------- pipeline projection --
+
+def pipeline_mfu_projection(measured_bubble: float, *,
+                            n_stages: int, microbatches: int,
+                            virtual_stages: int = 1,
+                            target_stages: int = 8,
+                            target_microbatches: int = 64,
+                            target_virtual_stages: Optional[int] = None
+                            ) -> float:
+    """Rescale a MEASURED pipeline bubble to a target shape — pure python.
+
+    The measured bubble (MPMD bench, real transport + real compute)
+    carries the rig's scheduling overhead ON TOP of the analytic
+    fill/drain bound; the target shape changes only the analytic part.
+    Projection = measured × analytic(target) / analytic(measured), which
+    preserves the measured overhead RATIO rather than assuming the
+    target magically hits the ideal bound. Falls back to the raw
+    measurement when the measured shape has no analytic bubble (S=1)."""
+    from kubeflow_tpu.parallel.mpmd import analytic_bubble_bound
+
+    meas_bound = analytic_bubble_bound(n_stages, microbatches,
+                                       virtual_stages)
+    tgt_bound = analytic_bubble_bound(
+        target_stages, target_microbatches,
+        virtual_stages if target_virtual_stages is None
+        else target_virtual_stages)
+    if meas_bound <= 0.0:
+        return measured_bubble
+    return measured_bubble * tgt_bound / meas_bound
+
+
+def apply_pipeline_projection(proof: ScaleProof, bubble: dict) -> None:
+    """Fold a measured interleaved-1F1B bubble into a training proof.
+
+    ``bubble`` is the bench's measurement record: ``bubble_fraction`` +
+    the (n_stages, microbatches, virtual_stages) shape it was measured
+    at (+ optional ``src``). The v5p-128 target shape is the ROADMAP
+    north star: 8 stages x 16 chips, interleaved."""
+    measured = float(bubble["bubble_fraction"])
+    s = int(bubble.get("n_stages", 2))
+    m = int(bubble.get("microbatches", 8))
+    v = int(bubble.get("virtual_stages", 1))
+    tgt_v = int(bubble.get("target_virtual_stages", max(v, 2)))
+    tgt_m = int(bubble.get("target_microbatches", 64))
+    projected = pipeline_mfu_projection(
+        measured, n_stages=s, microbatches=m, virtual_stages=v,
+        target_stages=8, target_microbatches=tgt_m,
+        target_virtual_stages=tgt_v)
+    proof.pipe_bubble_measured = round(measured, 4)
+    proof.pipe_bubble_projected = round(projected, 4)
+    proof.pipe_mfu = round(proof.est_mfu * (1.0 - projected), 4)
+    proof.pipe_basis = (
+        f"measured interleaved bubble {measured:.4f} at "
+        f"S={s} M={m} V={v} ({bubble.get('src', 'MPMD pipeline bench')}) "
+        f"rescaled by analytic(S=8, M={tgt_m}, V={tgt_v}) / "
+        f"analytic(measured shape) -> {projected:.4f}; pipe_mfu = "
+        "est_mfu x (1 - projected bubble) for the 8-stage x 16-chip "
+        "v5p-128 pipeline shape")
+
+
 # -------------------------------------------------------------- serving --
 
 def aot_serve_proof(
@@ -531,7 +599,8 @@ def aot_serve_proof(
 
 def scale_proofs(quick: bool = False,
                  measured_overlap: Optional[float] = None,
-                 overlap_src: str = "") -> list[ScaleProof]:
+                 overlap_src: str = "",
+                 measured_bubble: Optional[dict] = None) -> list[ScaleProof]:
     """The BASELINE.md ladder rows single-chip CI can't run:
 
     - row 4: Llama-3-8B serving on a v5p-8 (4-chip) slice, TP=4;
@@ -573,6 +642,11 @@ def scale_proofs(quick: bool = False,
             "v5p:4x4x2", num_slices=2,
             batch=64, seq=8192, name="llama3_70b-fsdp-v5p128",
             measured_overlap=measured_overlap, overlap_src=overlap_src))
+        if measured_bubble is not None:
+            # re-derive the v5p-128 MFU projection from the MEASURED
+            # interleaved bubble (8 stages x 16 chips is the pipeline
+            # decomposition of the same 64-chip 2-slice shape)
+            apply_pipeline_projection(out[-1], measured_bubble)
     return out
 
 

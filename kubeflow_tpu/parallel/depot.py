@@ -116,7 +116,7 @@ def toolchain_versions() -> dict:
 
 
 def fingerprint(hlo_text: str, mesh=None, platform: str = "",
-                extra: tuple = (), stage=None) -> str:
+                extra: tuple = (), stage=None, vstage=None) -> str:
     """Content-address a compiled program: sha256 over the lowered HLO,
     the mesh/topology it was built for, and the toolchain that built it.
     Everything that changes the machine code must be in here — two
@@ -134,11 +134,20 @@ def fingerprint(hlo_text: str, mesh=None, platform: str = "",
     may be a string role ("serving-prefill", "serving-decode-tier") so
     each tier's programs key separately. Int stages keep their exact
     pre-string key bytes.
+
+    ``vstage`` additionally scopes the key to a VIRTUAL chunk slot of an
+    interleaved-1F1B run (parallel/mpmd.py): a worker owns V chunks
+    whose programs can again lower to identical HLO with identical
+    global-chunk ids absent, and a warm resubmit must hit per CHUNK.
+    None (the default) leaves the key bytes unchanged — every existing
+    key is preserved.
     """
     h = hashlib.sha256()
     h.update(hlo_text.encode())
     if stage is not None:
         h.update(f"pipeline_stage={stage}".encode())
+    if vstage is not None:
+        h.update(f"virtual_stage={vstage}".encode())
     if mesh is not None:
         h.update(json.dumps(sorted(dict(mesh.shape).items())).encode())
         kinds = sorted({getattr(d, "device_kind", "?")
@@ -362,7 +371,7 @@ def _fetch(depot, key: str,
 
 
 def load_or_compile(lowered, depot=None, *, mesh=None, extra: tuple = (),
-                    stage=None,
+                    stage=None, vstage=None,
                     stats: Optional[DepotStats] = None,
                     wait_s: float = 0.0, poll_s: float = 0.5):
     """The one entry point: fingerprint ``lowered``, fetch the executable
@@ -378,12 +387,14 @@ def load_or_compile(lowered, depot=None, *, mesh=None, extra: tuple = (),
 
     ``stage`` scopes the key to an MPMD pipeline stage (identical HLO
     across stages must never share an entry — see ``fingerprint``);
-    ``mesh`` is then the stage's own mesh.
+    ``mesh`` is then the stage's own mesh. ``vstage`` further scopes to
+    one virtual chunk of an interleaved-1F1B worker.
     """
     stats = stats if stats is not None else DepotStats()
     if depot is None:
         return lowered.compile(), "no_depot"
-    key = fingerprint(lowered.as_text(), mesh=mesh, extra=extra, stage=stage)
+    key = fingerprint(lowered.as_text(), mesh=mesh, extra=extra, stage=stage,
+                      vstage=vstage)
 
     deadline = time.monotonic() + max(0.0, wait_s)
     waited = False

@@ -45,6 +45,26 @@ NEW ``stage=`` scope + the stage-mesh fingerprint, so a warm resubmit
 deserializes every stage's programs instead of recompiling — and two
 stages whose programs lower to IDENTICAL HLO (the common case: same
 stage_fn, same shapes) can never collide on one entry.
+
+Interleaved / virtual-stage 1F1B (``schedule="interleaved-1f1b"``,
+Megatron-style): each of the S workers owns V model CHUNKS — worker r
+holds global chunks {r, r+S, ..., r+(V-1)S} — so one microbatch crosses
+every worker V times and the fill/drain cost amortizes over V*M units:
+the analytic bubble drops from (S-1)/(S+M-1) to (S-1)/(V*M+S-1), BELOW
+the single-stage-per-worker floor. The ring gains a wrap link (worker
+S-1 -> worker 0 for activations, 0 -> S-1 for grad-activations) and
+frames are keyed (kind, step, mb, virtual_stage) so chunk traffic never
+aliases. The cost is activation stash: a worker holds up to
+warmup+1 = (S-r-1)*2 + (V-1)*S + 1 live chunk-activations (vs <= S for
+plain 1F1B) — measured and reported per stage. Grad slots still reduce
+in the one fixed descending-microbatch order per chunk, so the loss
+stays bitwise identical to GPipe and plain 1F1B over the same
+``total_stages`` chunk partition.
+
+The model behind the schedule is pluggable (``MLPSpec`` — the
+CI harness — or ``pipeline_llama.MpmdLlamaSpec``: real transformer
+blocks, embedding on chunk 0, LM head on the last chunk), selected by
+``KFT_MPMD_MODEL`` in the worker entry.
 """
 
 from __future__ import annotations
@@ -79,24 +99,47 @@ class PipelineRunConfig:
     microbatches: int = 4
     global_batch: int = 64
     dim: int = 128
-    layers_per_stage: int = 2
+    layers_per_stage: int = 2         # layers per CHUNK (= per stage at V=1)
     steps: int = 4
     lr: float = 0.05
     seed: int = 0
-    schedule: str = "1f1b"            # "gpipe" | "1f1b"
+    schedule: str = "1f1b"            # "gpipe" | "1f1b" | "interleaved-1f1b"
     dcn_delay_ms: float = 0.0         # emulated per-transfer DCN latency
+    virtual_stages: int = 1           # V chunks per worker (interleaved)
 
     @property
     def mb_rows(self) -> int:
         return self.global_batch // self.microbatches
+
+    @property
+    def total_stages(self) -> int:
+        """Global model-chunk count: worker r owns chunks r, r+S, ...,
+        r+(V-1)S. The model partition (and the oracle's pipeline depth)
+        is over total_stages, not workers."""
+        return self.n_stages * self.virtual_stages
 
     def validate(self) -> None:
         if self.n_stages < 2:
             raise ValueError("MPMD pipeline needs >= 2 stages")
         if self.global_batch % self.microbatches:
             raise ValueError("global_batch must divide by microbatches")
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved-1f1b"):
             raise ValueError(f"unknown schedule {self.schedule!r}")
+        if self.virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if self.schedule == "interleaved-1f1b":
+            if self.virtual_stages < 2:
+                raise ValueError(
+                    "interleaved-1f1b needs virtual_stages >= 2 "
+                    "(V=1 is plain 1f1b)")
+            if self.microbatches % self.n_stages:
+                raise ValueError(
+                    "interleaved-1f1b needs microbatches % n_stages == 0 "
+                    "(microbatch groups of size S keep the ring full)")
+        elif self.virtual_stages != 1:
+            raise ValueError(
+                f"virtual_stages={self.virtual_stages} requires the "
+                "interleaved-1f1b schedule")
 
     @classmethod
     def from_env(cls, env=None) -> "PipelineRunConfig":
@@ -113,6 +156,7 @@ class PipelineRunConfig:
             seed=int(g("SEED", "0")),
             schedule=g("SCHEDULE", "1f1b"),
             dcn_delay_ms=float(g("DCN_DELAY_MS", "0")),
+            virtual_stages=int(env.get("KFT_VIRTUAL_STAGES", "1")),
         )
 
 
@@ -149,7 +193,10 @@ def init_head_params(cfg: PipelineRunConfig):
     import jax
     import jax.numpy as jnp
 
-    k = jax.random.fold_in(jax.random.key(cfg.seed), cfg.n_stages + 17)
+    # keyed off the model-chunk count (== n_stages at V=1, so the PR 11
+    # values are unchanged): an interleaved run and a plain run over the
+    # same total_stages partition share one head — the bitwise contract
+    k = jax.random.fold_in(jax.random.key(cfg.seed), cfg.total_stages + 17)
     return {"w": jax.random.normal(k, (cfg.dim, 1), jnp.float32)
             * (1.0 / np.sqrt(cfg.dim))}
 
@@ -180,19 +227,53 @@ def head_loss(head_params, y, targets, *, microbatches: int):
 # ------------------------------------------------------------ schedule --
 
 def schedule_ticks(schedule: str, n_stages: int, stage: int,
-                   microbatches: int) -> list[tuple[str, int]]:
+                   microbatches: int, virtual_stages: int = 1) -> list:
     """The per-stage tick order. GPipe: fill-drain (all forwards, then
     all backwards — activation stash grows to M). 1F1B: (S-1-s) warmup
     forwards, then strict one-forward-one-backward, then drain — the
     stash never exceeds S live microbatches, which is the memory
     headroom that lets 1F1B run more microbatches than GPipe at the
-    same budget (the schedule's real advantage; see aggregate_stats)."""
+    same budget (the schedule's real advantage; see aggregate_stats).
+
+    GPipe/1F1B tick = (phase, mb). ``interleaved-1f1b`` tick =
+    (phase, vchunk, mb): worker ``stage`` cycles its V chunks in
+    microbatch GROUPS of size S (the Megatron interleave — unit k
+    forwards chunk (k % (S*V)) // S, microbatch (k // (S*V))*S + k % S;
+    backward units mirror the chunk index), after a warmup of
+    (S-stage-1)*2 + (V-1)*S forward units. Backward unit order is the
+    exact reverse-chunk mirror of forward order, so every chunk's
+    microbatch grads still land in slots reduced in ONE descending
+    order — the bitwise contract with GPipe/1F1B/the oracle."""
     M = microbatches
     if schedule == "gpipe":
         return ([("fwd", i) for i in range(M)]
                 + [("bwd", i) for i in reversed(range(M))])
+    if schedule == "interleaved-1f1b":
+        S, V = n_stages, virtual_stages
+        if V < 2:
+            raise ValueError("interleaved-1f1b needs virtual_stages >= 2")
+        if M % S:
+            raise ValueError(
+                "interleaved-1f1b needs microbatches % n_stages == 0")
+        total = M * V
+
+        def fwd_unit(k: int) -> tuple[int, int]:
+            return (k % (S * V)) // S, (k // (S * V)) * S + k % S
+
+        def bwd_unit(k: int) -> tuple[int, int]:
+            v, mb = fwd_unit(k)
+            return V - 1 - v, mb
+
+        warm = min((S - stage - 1) * 2 + (V - 1) * S, total)
+        ticks = [("fwd", *fwd_unit(k)) for k in range(warm)]
+        for i in range(total - warm):
+            ticks.append(("fwd", *fwd_unit(warm + i)))
+            ticks.append(("bwd", *bwd_unit(i)))
+        ticks.extend(("bwd", *bwd_unit(i))
+                     for i in range(total - warm, total))
+        return ticks
     warm = min(n_stages - 1 - stage, M)
-    ticks: list[tuple[str, int]] = [("fwd", i) for i in range(warm)]
+    ticks = [("fwd", i) for i in range(warm)]
     done = 0
     for i in range(warm, M):
         ticks.append(("fwd", i))
@@ -202,12 +283,23 @@ def schedule_ticks(schedule: str, n_stages: int, stage: int,
     return ticks
 
 
-def max_live_stash(ticks: list[tuple[str, int]]) -> int:
+def interleaved_stash_bound(n_stages: int, stage: int, microbatches: int,
+                            virtual_stages: int) -> int:
+    """Analytic peak chunk-activation stash for one worker under
+    interleaved-1F1B: the warmup depth plus the in-flight steady-state
+    forward — the V-chunk memory cost the schedule pays for its bubble
+    win (each unit is one CHUNK's activation, 1/V of a plain stage's)."""
+    S, V, M = n_stages, virtual_stages, microbatches
+    return min((S - stage - 1) * 2 + (V - 1) * S + 1, M * V)
+
+
+def max_live_stash(ticks: list) -> int:
     """Peak number of forward activations resident between their fwd and
-    bwd ticks — the schedule's activation-memory footprint."""
+    bwd ticks — the schedule's activation-memory footprint (in CHUNK
+    activations for the interleaved schedule's 3-field ticks)."""
     live, peak = 0, 0
-    for phase, _ in ticks:
-        live += 1 if phase == "fwd" else -1
+    for t in ticks:
+        live += 1 if t[0] == "fwd" else -1
         peak = max(peak, live)
     return peak
 
@@ -307,10 +399,17 @@ class TCPStageChannel:
 
     def __init__(self, bind: str, *, prev: Optional[str], next: Optional[str],
                  stage: int, blocking: bool = True, delay_s: float = 0.0,
-                 collector=None, timeout_s: float = 120.0):
+                 collector=None, timeout_s: float = 120.0,
+                 wrap_next: Optional[str] = None,
+                 wrap_prev: Optional[str] = None):
         self.stage = stage
         self.prev_addr = prev
         self.next_addr = next
+        # interleaved ring closure: the LAST worker forwards chunk
+        # r+vS -> chunk (v+1)S on worker 0 over wrap_next; worker 0
+        # returns grad-activations over wrap_prev. None on plain runs.
+        self.wrap_next_addr = wrap_next
+        self.wrap_prev_addr = wrap_prev
         self.blocking = blocking
         self.delay_s = delay_s
         self.timeout_s = timeout_s
@@ -412,10 +511,11 @@ class TCPStageChannel:
         t0 = time.perf_counter()
         span = None
         if self.collector is not None:
-            span = self.collector.start(
-                "dcn.transfer", attrs={"stage": self.stage, "peer": peer,
-                                       "kind": key[0], "step": key[1],
-                                       "mb": key[2]})
+            attrs = {"stage": self.stage, "peer": peer, "kind": key[0],
+                     "step": key[1], "mb": key[2]}
+            if len(key) > 3:
+                attrs["vstage"] = key[3]
+            span = self.collector.start("dcn.transfer", attrs=attrs)
         data = _encode(key, payload)
         if self.delay_s:
             time.sleep(self.delay_s)
@@ -460,18 +560,35 @@ class TCPStageChannel:
         self.stats.add(send_block_s=time.perf_counter() - t0)  # ~enqueue
 
     # ------------------------------------------------------------- api --
+    # Frames key by (kind, step, mb, virtual_stage) so the same
+    # microbatch crossing the same worker V times (interleaved) never
+    # aliases; vstage defaults to 0 so plain callers are unchanged.
+    # ``wrap=True`` routes over the ring-closure link instead of the
+    # line neighbor (see __init__).
 
-    def send_act(self, step: int, mb: int, payload) -> None:
-        self._send(self.next_addr, ("act", step, mb), payload)
+    def send_act(self, step: int, mb: int, payload, vstage: int = 0, *,
+                 wrap: bool = False) -> None:
+        peer = self.wrap_next_addr if wrap else self.next_addr
+        if peer is None:
+            raise RuntimeError(
+                f"stage {self.stage}: no {'wrap_next' if wrap else 'next'} "
+                "peer for send_act")
+        self._send(peer, ("act", step, mb, vstage), payload)
 
-    def send_grad(self, step: int, mb: int, payload) -> None:
-        self._send(self.prev_addr, ("grad", step, mb), payload)
+    def send_grad(self, step: int, mb: int, payload, vstage: int = 0, *,
+                  wrap: bool = False) -> None:
+        peer = self.wrap_prev_addr if wrap else self.prev_addr
+        if peer is None:
+            raise RuntimeError(
+                f"stage {self.stage}: no {'wrap_prev' if wrap else 'prev'} "
+                "peer for send_grad")
+        self._send(peer, ("grad", step, mb, vstage), payload)
 
-    def recv_act(self, step: int, mb: int):
-        return self._recv(("act", step, mb))
+    def recv_act(self, step: int, mb: int, vstage: int = 0):
+        return self._recv(("act", step, mb, vstage))
 
-    def recv_grad(self, step: int, mb: int):
-        return self._recv(("grad", step, mb))
+    def recv_grad(self, step: int, mb: int, vstage: int = 0):
+        return self._recv(("grad", step, mb, vstage))
 
     def _recv(self, key: tuple):
         t0 = time.perf_counter()
@@ -546,10 +663,11 @@ class InProcChannel:
         t0 = time.perf_counter()
         span = None
         if self.collector is not None:
-            span = self.collector.start(
-                "dcn.transfer", attrs={"stage": self.stage, "peer": dest,
-                                       "kind": key[0], "step": key[1],
-                                       "mb": key[2]})
+            attrs = {"stage": self.stage, "peer": dest, "kind": key[0],
+                     "step": key[1], "mb": key[2]}
+            if len(key) > 3:
+                attrs["vstage"] = key[3]
+            span = self.collector.start("dcn.transfer", attrs=attrs)
         data = _encode(key, payload)       # pay real serialize cost
         if self.delay_s:
             time.sleep(self.delay_s)
@@ -583,17 +701,21 @@ class InProcChannel:
         self._q.put((dest, key, payload))
         self.stats.add(send_block_s=time.perf_counter() - t0)
 
-    def send_act(self, step, mb, payload):
-        self._send(self.stage + 1, ("act", step, mb), payload)
+    def send_act(self, step, mb, payload, vstage: int = 0, *,
+                 wrap: bool = False):
+        dest = 0 if wrap else self.stage + 1
+        self._send(dest, ("act", step, mb, vstage), payload)
 
-    def send_grad(self, step, mb, payload):
-        self._send(self.stage - 1, ("grad", step, mb), payload)
+    def send_grad(self, step, mb, payload, vstage: int = 0, *,
+                  wrap: bool = False):
+        dest = len(self.fabric.mailboxes) - 1 if wrap else self.stage - 1
+        self._send(dest, ("grad", step, mb, vstage), payload)
 
-    def recv_act(self, step, mb):
-        return self._recv(("act", step, mb))
+    def recv_act(self, step, mb, vstage: int = 0):
+        return self._recv(("act", step, mb, vstage))
 
-    def recv_grad(self, step, mb):
-        return self._recv(("grad", step, mb))
+    def recv_grad(self, step, mb, vstage: int = 0):
+        return self._recv(("grad", step, mb, vstage))
 
     def _recv(self, key):
         t0 = time.perf_counter()
@@ -611,22 +733,86 @@ class InProcChannel:
             self._sender.join(timeout=5.0)
 
 
+# -------------------------------------------------------- model spec --
+
+class MLPSpec:
+    """The pluggable-model contract behind StageRuntime/run_stage, with
+    the CI harness (stacked tanh-MLP chunks + MSE head) as the default
+    implementation. A spec answers, per GLOBAL chunk index in
+    [0, cfg.total_stages): the chunk's params and (params, x) -> y
+    program, the example activation shapes the programs lower against,
+    the per-microbatch head loss, and the host-side step batch.
+    ``pipeline_llama.MpmdLlamaSpec`` implements the same surface with
+    real transformer blocks (embedding folded into chunk 0, LM head on
+    the last chunk — its tokens input is int, so its chunk-0 backward
+    is params-only: ``first_chunk_needs_dx = False``)."""
+
+    name = "mlp"
+    # chunk 0's VJP also pulls back to x (floats): kept for the MLP so
+    # the compiled program (and its depot key) is byte-identical to the
+    # PR 11 single-chunk runtime
+    first_chunk_needs_dx = True
+
+    def __init__(self, stage_fn: Callable = mlp_stage_fn):
+        self.stage_fn = stage_fn
+
+    def chunk_fn(self, cfg: PipelineRunConfig, chunk: int) -> Callable:
+        return self.stage_fn
+
+    def chunk_params(self, cfg: PipelineRunConfig, chunk: int):
+        return init_stage_params(cfg, chunk)
+
+    def head_params(self, cfg: PipelineRunConfig):
+        return init_head_params(cfg)
+
+    def head_fn(self, cfg: PipelineRunConfig) -> Callable:
+        M = cfg.microbatches
+
+        def fn(hp, y, t):
+            return head_loss(hp, y, t, microbatches=M)
+        return fn
+
+    def example_x(self, cfg: PipelineRunConfig, chunk: int):
+        import jax.numpy as jnp
+
+        return jnp.zeros((cfg.mb_rows, cfg.dim), jnp.float32)
+
+    def example_y(self, cfg: PipelineRunConfig):
+        return self.example_x(cfg, cfg.total_stages - 1)
+
+    def example_t(self, cfg: PipelineRunConfig):
+        import jax.numpy as jnp
+
+        return jnp.zeros((cfg.mb_rows, 1), jnp.float32)
+
+    def batch(self, cfg: PipelineRunConfig, step: int):
+        """Host-side (inputs [M, R, ...], targets [M, R, ...]) for one
+        step — worker 0 consumes inputs, the head worker targets."""
+        M, R = cfg.microbatches, cfg.mb_rows
+        x, t = step_batch(cfg, step)
+        return (np.asarray(x).reshape(M, R, cfg.dim),
+                np.asarray(t).reshape(M, R, 1))
+
+
 # -------------------------------------------------------- stage runtime --
 
 class StageRuntime:
-    """One stage's compiled programs + parameters on its own mesh.
+    """One worker's compiled programs + parameters on its own mesh —
+    for its V model chunks (V=1 outside interleaved runs).
 
-    Programs are AOT-compiled up front (fwd, bwd = VJP of stage_fn, and
-    on the last stage the loss-head VJP) through the executable depot
-    when one is given — keyed per STAGE + stage mesh, so a warm resubmit
-    deserializes instead of compiling and two same-HLO stages never
-    share an entry. Gradients stash per microbatch slot and reduce in
-    one fixed descending-index order (matching the scan-VJP accumulation
-    order of the SPMD oracle), so the result is schedule-independent —
-    GPipe and 1F1B produce bitwise-identical updates."""
+    Programs are AOT-compiled up front (per-chunk fwd, bwd = VJP of the
+    chunk fn, and on the head worker the loss-head VJP) through the
+    executable depot when one is given — keyed per GLOBAL CHUNK + stage
+    mesh (+ the virtual-chunk scope when V > 1), so a warm resubmit
+    deserializes every chunk's programs and two same-HLO chunks never
+    share an entry. Gradients stash per (chunk, microbatch) slot and
+    reduce in one fixed descending-index order per chunk (matching the
+    scan-VJP accumulation order of the SPMD oracle), so the result is
+    schedule-independent — GPipe, 1F1B and interleaved-1F1B produce
+    bitwise-identical updates."""
 
     def __init__(self, cfg: PipelineRunConfig, stage: int, *,
-                 stage_fn: Callable = mlp_stage_fn, mesh=None,
+                 stage_fn: Callable = mlp_stage_fn, spec=None, mesh=None,
                  depot=None, depot_stats: Optional[DepotStats] = None,
                  depot_wait_s: float = 0.0):
         import jax
@@ -636,26 +822,25 @@ class StageRuntime:
         self.cfg = cfg
         self.stage = stage
         self.is_first = stage == 0
-        self.is_last = stage == cfg.n_stages - 1
+        self.is_last = stage == cfg.n_stages - 1   # head worker
         self.mesh = mesh
+        self.spec = spec if spec is not None else MLPSpec(stage_fn)
         self.depot_stats = depot_stats if depot_stats is not None \
             else DepotStats()
         self.depot_outcomes: dict[str, str] = {}
-        self.params = init_stage_params(cfg, stage)
-        self.head_params = init_head_params(cfg) if self.is_last else None
+        V = cfg.virtual_stages
+        # global chunk ids this worker owns: stage, stage+S, ...
+        self.chunks = [stage + v * cfg.n_stages for v in range(V)]
+        self.params = [self.spec.chunk_params(cfg, c) for c in self.chunks]
+        self.head_params = (self.spec.head_params(cfg)
+                            if self.is_last else None)
         self._last_losses: list = []
 
-        M = cfg.microbatches
-        R = cfg.mb_rows
-
-        def bwd_fn(p, x, dy):
-            _, pull = jax.vjp(stage_fn, p, x)
-            return pull(dy)
+        head_loss_fn = self.spec.head_fn(cfg)
 
         def head_fn(hp, y, t):
             (loss, (gh, dy)) = jax.value_and_grad(
-                lambda hp_, y_, t_: head_loss(hp_, y_, t_, microbatches=M),
-                argnums=(0, 1))(hp, y, t)
+                head_loss_fn, argnums=(0, 1))(hp, y, t)
             return loss, gh, dy
 
         def sgd(p, g):
@@ -675,8 +860,9 @@ class StageRuntime:
                 acc = self._add(acc, g)
             return acc
 
-        x_eg = jnp.zeros((R, cfg.dim), jnp.float32)
-        t_eg = jnp.zeros((R, 1), jnp.float32)
+        x_egs = [self.spec.example_x(cfg, c) for c in self.chunks]
+        y_eg = self.spec.example_y(cfg)
+        t_eg = self.spec.example_t(cfg)
         if mesh is not None:
             # per-stage mesh: microbatch rows sharded over the stage's
             # data axis, params replicated within the stage. The jitted
@@ -685,37 +871,66 @@ class StageRuntime:
 
             self._x_sharding = NamedSharding(mesh, P("stage_dp"))
             self._rep = NamedSharding(mesh, P())
-            self.params = jax.device_put(self.params, self._rep)
+            self.params = [jax.device_put(p, self._rep)
+                           for p in self.params]
             if self.head_params is not None:
                 self.head_params = jax.device_put(self.head_params,
                                                   self._rep)
-            x_eg = jax.device_put(x_eg, self._x_sharding)
+            x_egs = [jax.device_put(x, self._x_sharding) for x in x_egs]
+            y_eg = jax.device_put(y_eg, self._x_sharding)
             t_eg = jax.device_put(t_eg, self._x_sharding)
         else:
             self._x_sharding = None
 
-        def _compile(name, fn, *eg):
+        def _compile(name, fn, chunk, vchunk, *eg):
             lowered = jax.jit(fn).lower(*eg)
             compiled, outcome = load_or_compile(
-                lowered, depot, mesh=mesh, stage=stage,
+                lowered, depot, mesh=mesh, stage=chunk,
+                vstage=vchunk if V > 1 else None,
                 extra=("mpmd", name), stats=self.depot_stats,
                 wait_s=depot_wait_s)
-            self.depot_outcomes[name] = outcome
+            label = name if V == 1 else f"{name}.c{chunk}"
+            self.depot_outcomes[label] = outcome
             return compiled
 
-        self._fwd = _compile("fwd", stage_fn, self.params, x_eg)
-        dy_eg = x_eg
-        self._bwd = _compile("bwd", bwd_fn, self.params, x_eg, dy_eg)
+        self._fwds, self._bwds, self._bwd_has_dx = [], [], []
+        for v, c in enumerate(self.chunks):
+            fn = self.spec.chunk_fn(cfg, c)
+            needs_dx = c > 0 or self.spec.first_chunk_needs_dx
+            if needs_dx:
+                def bwd_fn(p, x, dy, _fn=fn):
+                    _, pull = jax.vjp(_fn, p, x)
+                    return pull(dy)
+            else:
+                # chunk 0 of an int-input model (llama tokens): the
+                # pullback is params-only — there is no dx to emit and
+                # nothing upstream to send it to
+                def bwd_fn(p, x, dy, _fn=fn):
+                    _, pull = jax.vjp(lambda p_: _fn(p_, x), p)
+                    return pull(dy)[0]
+            # dy has the CHUNK OUTPUT's shape: the next chunk's input
+            # (chunk c+1 is never chunk 0, so example_x is float there)
+            dy_eg = (y_eg if c == cfg.total_stages - 1
+                     else self.spec.example_x(cfg, c + 1))
+            if mesh is not None:
+                dy_eg = jax.device_put(dy_eg, self._x_sharding)
+            self._fwds.append(
+                _compile("fwd", fn, c, v, self.params[v], x_egs[v]))
+            self._bwds.append(
+                _compile("bwd", bwd_fn, c, v,
+                         self.params[v], x_egs[v], dy_eg))
+            self._bwd_has_dx.append(needs_dx)
         if self.is_last:
-            self._head = _compile("head", head_fn, self.head_params,
-                                  x_eg, t_eg)
+            self._head = _compile("head", head_fn, cfg.total_stages - 1,
+                                  V - 1, self.head_params, y_eg, t_eg)
         # tiny programs: warmed eagerly so no compile lands inside the
         # measured window, but not worth depot entries
-        g_eg = jax.tree_util.tree_map(jnp.zeros_like, self.params)
         self._sgd = jax.jit(sgd)
         self._reduce = reduce_slots
-        jax.block_until_ready(self._sgd(self.params, g_eg))
-        jax.block_until_ready(self._add(g_eg, g_eg))
+        for p in self.params:
+            g_eg = jax.tree_util.tree_map(jnp.zeros_like, p)
+            jax.block_until_ready(self._sgd(p, g_eg))
+            jax.block_until_ready(self._add(g_eg, g_eg))
 
     # ------------------------------------------------------- execution --
 
@@ -734,17 +949,21 @@ class StageRuntime:
 
         return np.asarray(jax.device_get(y))
 
-    def fwd(self, x):
+    def fwd(self, x, v: int = 0):
         import jax
 
-        return jax.block_until_ready(self._fwd(self.params, x))
+        return jax.block_until_ready(self._fwds[v](self.params[v], x))
 
-    def bwd(self, x, dy):
+    def bwd(self, x, dy, v: int = 0):
         import jax
 
-        g, dx = self._bwd(self.params, x, dy)
-        jax.block_until_ready(dx)
-        return g, dx
+        if self._bwd_has_dx[v]:
+            g, dx = self._bwds[v](self.params[v], x, dy)
+            jax.block_until_ready(dx)
+            return g, dx
+        g = self._bwds[v](self.params[v], x, dy)
+        jax.block_until_ready(g)
+        return g, None
 
     def head(self, y, t):
         import jax
@@ -754,9 +973,15 @@ class StageRuntime:
         return loss, gh, dy
 
     def apply_grads(self, grad_slots: list, head_slots: Optional[list]):
+        """``grad_slots``: per-chunk slot lists ([V][M]) or one flat [M]
+        list (the V=1 shape callers have always passed)."""
         import jax
 
-        self.params = self._sgd(self.params, self._reduce(grad_slots))
+        per_chunk = (grad_slots
+                     if grad_slots and isinstance(grad_slots[0], list)
+                     else [grad_slots])
+        for v, slots in enumerate(per_chunk):
+            self.params[v] = self._sgd(self.params[v], self._reduce(slots))
         if head_slots is not None:
             self.head_params = self._sgd(self.head_params,
                                          self._reduce(head_slots))
@@ -806,19 +1031,20 @@ def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
     import jax  # noqa: F401  (device staging inside runtime)
 
     rt = runtime if runtime is not None else StageRuntime(cfg, stage)
-    ticks = schedule_ticks(cfg.schedule, cfg.n_stages, stage,
-                           cfg.microbatches)
-    M, R = cfg.microbatches, cfg.mb_rows
+    spec = rt.spec
+    S, V, M = cfg.n_stages, cfg.virtual_stages, cfg.microbatches
+    T = cfg.total_stages
+    raw = schedule_ticks(cfg.schedule, S, stage, M, cfg.virtual_stages)
+    # normalize 2-field (phase, mb) ticks to (phase, vchunk=0, mb)
+    ticks = [t if len(t) == 3 else (t[0], 0, t[1]) for t in raw]
     chan.barrier_ready()
     step_stats = []
     losses: list = []
     for k in range(cfg.steps):
         if rt.is_first:
-            x_full, _ = step_batch(cfg, k)
-            x_host = np.asarray(x_full).reshape(M, R, cfg.dim)
+            x_host, _ = spec.batch(cfg, k)
         if rt.is_last:
-            _, t_full = step_batch(cfg, k)
-            t_host = np.asarray(t_full).reshape(M, R, 1)
+            _, t_host = spec.batch(cfg, k)
         # perf_counter, not wall clock: windows and busy must share a
         # clock domain (aggregate_stats only ever compares DURATIONS —
         # stage 0's window vs each stage's busy — so process-local
@@ -826,53 +1052,66 @@ def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
         t_step0 = time.perf_counter()
         busy = 0.0
         block0 = chan.stats.snapshot()["send_block_s"]
-        stash: dict[int, tuple] = {}
-        grad_slots: list = [None] * M
+        stash: dict[tuple, tuple] = {}
+        grad_slots: list = [[None] * M for _ in range(V)]
         head_slots: Optional[list] = [None] * M if rt.is_last else None
         step_losses: list = [None] * M
-        for phase, i in ticks:
+        for phase, v, i in ticks:
+            c = stage + v * S              # global chunk this tick runs
             span = None
             if collector is not None:
                 span = collector.start("pipeline.tick", attrs={
-                    "stage": stage, "step": k, "mb": i, "phase": phase})
+                    "stage": stage, "step": k, "mb": i, "phase": phase,
+                    "vstage": v, "chunk": c})
             if phase == "fwd":
-                if rt.is_first:
+                if c == 0:
                     c0 = time.perf_counter()
                     x = rt.put_act(x_host[i])
                     busy += time.perf_counter() - c0
                 else:
-                    arr = chan.recv_act(k, i)
+                    arr = chan.recv_act(k, i, v)
                     c0 = time.perf_counter()
                     x = rt.put_act(arr)
                     busy += time.perf_counter() - c0
                 c0 = time.perf_counter()
-                y = rt.fwd(x)
+                y = rt.fwd(x, v)
                 busy += time.perf_counter() - c0
-                stash[i] = (x, y)
-                if not rt.is_last:
+                stash[(v, i)] = (x, y)
+                if c < T - 1:
                     c0 = time.perf_counter()
-                    chan.send_act(k, i, rt.get_act(y))
+                    payload = rt.get_act(y)
+                    if stage < S - 1:
+                        chan.send_act(k, i, payload, v)
+                    else:
+                        # ring wrap: chunk (v+1)*S lives on worker 0
+                        chan.send_act(k, i, payload, v + 1, wrap=True)
                     busy += time.perf_counter() - c0
             else:
-                x, y = stash.pop(i)
-                if rt.is_last:
+                x, y = stash.pop((v, i))
+                if c == T - 1:
                     c0 = time.perf_counter()
                     t = rt.put_act(t_host[i])
                     loss_i, gh, dy = rt.head(y, t)
-                    g, dx = rt.bwd(x, dy)
+                    g, dx = rt.bwd(x, dy, v)
                     busy += time.perf_counter() - c0
                     head_slots[i] = gh
                     step_losses[i] = loss_i
                 else:
-                    dy_arr = chan.recv_grad(k, i)
+                    dy_arr = chan.recv_grad(k, i, v)
                     c0 = time.perf_counter()
                     dy = rt.put_act(dy_arr)
-                    g, dx = rt.bwd(x, dy)
+                    g, dx = rt.bwd(x, dy, v)
                     busy += time.perf_counter() - c0
-                grad_slots[i] = g
-                if not rt.is_first:
+                grad_slots[v][i] = g
+                if c > 0:
                     c0 = time.perf_counter()
-                    chan.send_grad(k, i, rt.get_act(dx))
+                    payload = rt.get_act(dx)
+                    if stage > 0:
+                        chan.send_grad(k, i, payload, v)
+                    else:
+                        # ring wrap back: chunk v*S - 1 is worker S-1's
+                        # virtual chunk v-1
+                        chan.send_grad(k, i, payload, v - 1, wrap=True)
                     busy += time.perf_counter() - c0
             if span is not None:
                 collector.end(span)
@@ -901,11 +1140,14 @@ def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
 
 # --------------------------------------------------------- measurement --
 
-def analytic_bubble_bound(n_stages: int, microbatches: int) -> float:
+def analytic_bubble_bound(n_stages: int, microbatches: int,
+                          virtual_stages: int = 1) -> float:
     """The fill-drain bound: stage s idles s ticks at fill and S-1-s at
     drain, per phase — (S-1)/(S+M-1) of the schedule, independent of the
-    fwd/bwd time ratio (both phases scale together)."""
-    return (n_stages - 1) / (n_stages + microbatches - 1)
+    fwd/bwd time ratio (both phases scale together). With virtual
+    stages the same S-1 fill/drain units amortize over V*M chunk units:
+    (S-1)/(V*M+S-1) — strictly below the V=1 floor for V >= 2."""
+    return (n_stages - 1) / (virtual_stages * microbatches + n_stages - 1)
 
 
 def aggregate_stats(results: list, cfg: PipelineRunConfig,
@@ -937,22 +1179,34 @@ def aggregate_stats(results: list, cfg: PipelineRunConfig,
     overlap = (1.0 - min(blocked, wire) / wire) if wire > 0 else None
     busy = [sum(st["busy_s"] for st in r["step_stats"][skip_steps:n_steps])
             for r in rs]
-    ticks = 2 * cfg.microbatches * max(1, n_steps - skip_steps)
+    V = cfg.virtual_stages
+    ticks = 2 * cfg.microbatches * V * max(1, n_steps - skip_steps)
+    interleaved = cfg.schedule == "interleaved-1f1b"
     return {
         "schedule": cfg.schedule,
         "n_stages": S,
+        "virtual_stages": V,
         "microbatches": cfg.microbatches,
         "steps_measured": max(0, n_steps - skip_steps),
         "bubble_fraction": round(bubble, 4) if bubble is not None else None,
         "bubble_fraction_per_step": [round(b, 4) for b in per_step],
+        # the V=1 floor — what interleaving must beat at matched M
         "analytic_fill_drain_bound": round(
             analytic_bubble_bound(S, cfg.microbatches), 4),
+        "analytic_interleaved_bound": (round(analytic_bubble_bound(
+            S, cfg.microbatches, V), 4) if V > 1 else None),
         "dcn_overlap_fraction": (round(overlap, 4)
                                  if overlap is not None else None),
         "dcn_wire_s": round(wire, 4),
         "dcn_send_block_s": round(blocked, 4),
         "mean_tick_s": round(sum(busy) / (S * ticks), 6) if ticks else None,
+        # stash units are CHUNK activations (1/V of a plain stage's):
+        # the V-chunk memory cost, checked against the analytic bound
         "max_activation_stash": max(r["max_stash"] for r in rs),
+        "stash_per_stage": [r["max_stash"] for r in rs],
+        "stash_bound_per_stage": (
+            [interleaved_stash_bound(S, s, cfg.microbatches, V)
+             for s in range(S)] if interleaved else None),
         "per_stage_busy_s": [round(b, 4) for b in busy],
         "est_basis": "measured (per-stage busy vs stage-0 step windows; "
                      "overlap = 1 - send_block/wire)",
@@ -999,9 +1253,11 @@ def run_inproc(cfg: PipelineRunConfig, *, collector=None,
 def run_oracle(cfg: PipelineRunConfig,
                stage_fn: Callable = mlp_stage_fn) -> list[float]:
     """The single-program SPMD oracle: the SAME model/microbatching/loss
-    through ``pipeline_apply`` on a pipeline mesh (needs >= n_stages
-    local devices), same SGD updates. The MPMD runs must reproduce this
-    loss trajectory (step 0 bitwise; later steps to fusion-level ulps)."""
+    through ``pipeline_apply`` on a pipeline mesh over ``total_stages``
+    chunks (needs >= total_stages local devices), same SGD updates. The
+    MPMD runs — plain AND interleaved, which partition the model over
+    the same total_stages chunks — must reproduce this loss trajectory
+    (step 0 bitwise; later steps to fusion-level ulps)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -1011,12 +1267,13 @@ def run_oracle(cfg: PipelineRunConfig,
     )
 
     cfg.validate()
+    T = cfg.total_stages
     devs = jax.devices()
-    if len(devs) < cfg.n_stages:
+    if len(devs) < T:
         raise RuntimeError(
-            f"oracle needs {cfg.n_stages} devices, have {len(devs)} "
+            f"oracle needs {T} devices, have {len(devs)} "
             "(set --xla_force_host_platform_device_count)")
-    mesh = Mesh(np.array(devs[:cfg.n_stages]), ("pipeline",))
+    mesh = Mesh(np.array(devs[:T]), ("pipeline",))
     fwd = pipeline_apply(stage_fn, mesh, microbatches=cfg.microbatches)
     M, R = cfg.microbatches, cfg.mb_rows
 
@@ -1030,7 +1287,7 @@ def run_oracle(cfg: PipelineRunConfig,
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
     stacked = stack_stage_params(
-        [init_stage_params(cfg, s) for s in range(cfg.n_stages)])
+        [init_stage_params(cfg, s) for s in range(T)])
     hp = init_head_params(cfg)
     losses = []
     for k in range(cfg.steps):
@@ -1073,20 +1330,24 @@ def _worker_main() -> int:
         print("KFT_NUM_STAGES not set: not an MPMD stage worker")
         return 2
     if info.stage_proc_id > 0:
-        # multi-worker stages carry the env contract (stage-local ranks,
-        # for per-stage jax.distributed groups on real slices) but this
-        # runner executes one process per stage — extra stage workers
-        # exit cleanly instead of racing proc 0 for the stage bind
+        # multi-worker stages carry the full group env contract
+        # (KFT_STAGE_GROUP_SIZE/RANK/COORD — the per-stage
+        # jax.distributed rendezvous triplet) but this runner executes
+        # one process per stage — extra stage workers report their group
+        # identity and exit cleanly instead of racing proc 0 for the
+        # stage bind
         print(f"stage {info.stage_id} proc {info.stage_proc_id}: "
-              "intra-stage worker groups are a future surface; proc 0 "
-              "owns the stage program")
+              f"group rank {info.group_rank}/{info.group_size} "
+              f"(coord {info.group_coord}); per-stage jax.distributed is "
+              "a future surface; proc 0 owns the stage program")
         return 0
     cfg = PipelineRunConfig.from_env()
     collector = SpanCollector(proc=f"stage{info.stage_id}")
     chan = TCPStageChannel(
         info.bind, prev=info.prev, next=info.next, stage=info.stage_id,
         blocking=cfg.schedule == "gpipe", delay_s=cfg.dcn_delay_ms / 1e3,
-        collector=collector)
+        collector=collector, wrap_next=info.wrap_next,
+        wrap_prev=info.wrap_prev)
     _phase(phases, "rendezvous_done")
 
     dstats = DepotStats()
@@ -1095,7 +1356,13 @@ def _worker_main() -> int:
     except Exception:
         dstats.inc("fetch_errors")
         depot = None
-    rt = StageRuntime(cfg, info.stage_id, depot=depot, depot_stats=dstats)
+    spec = None
+    if os.environ.get("KFT_MPMD_MODEL", "mlp") == "llama":
+        from kubeflow_tpu.parallel.pipeline_llama import mpmd_llama_spec
+
+        spec = mpmd_llama_spec(cfg)
+    rt = StageRuntime(cfg, info.stage_id, depot=depot, depot_stats=dstats,
+                      spec=spec)
     phases["depot_hit"] = 1.0 if rt.depot_summary()["hit"] else 0.0
     phases["stage_id"] = float(info.stage_id)
     _phase(phases, "compile_done",
@@ -1159,7 +1426,14 @@ def _oracle_main() -> int:
     if os.environ.get("KFT_FORCE_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["KFT_FORCE_PLATFORM"])
     cfg = PipelineRunConfig.from_env()
-    losses = run_oracle(cfg)
+    if os.environ.get("KFT_MPMD_MODEL", "mlp") == "llama":
+        from kubeflow_tpu.parallel.pipeline_llama import (
+            mpmd_llama_spec, run_mpmd_llama_oracle,
+        )
+
+        losses = run_mpmd_llama_oracle(cfg, mpmd_llama_spec(cfg))
+    else:
+        losses = run_oracle(cfg)
     report_dir = os.environ.get("KFT_MPMD_REPORT_DIR", ".")
     os.makedirs(report_dir, exist_ok=True)
     with open(os.path.join(report_dir, "oracle.json"), "w") as f:

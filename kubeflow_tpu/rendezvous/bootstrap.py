@@ -79,6 +79,20 @@ class StageInfo:
     next: Optional[str] = None     # stage_id+1's address (acts go here)
     stage_workers: int = 1         # workers per stage (multi-host stages)
     stage_proc_id: int = 0         # rank within the stage's worker group
+    # interleaved-1F1B (virtual stages): each worker owns V model chunks
+    # (chunk stage_id, stage_id+S, ...). The chunk graph wraps around the
+    # worker ring, so the last worker also sends activations to worker 0
+    # (wrap_next) and worker 0 sends grads to the last worker (wrap_prev).
+    virtual_stages: int = 1
+    wrap_next: Optional[str] = None  # stage S-1 -> stage 0 activation link
+    wrap_prev: Optional[str] = None  # stage 0 -> stage S-1 grad link
+    # per-stage worker group identity (multi-worker stages): the group is
+    # the future per-stage jax.distributed world; size/rank/coord are its
+    # rendezvous triplet, stamped even before that world exists so the
+    # contract round-trips today.
+    group_size: int = 1
+    group_rank: int = 0
+    group_coord: Optional[str] = None
 
     @property
     def is_first(self) -> bool:
@@ -98,14 +112,22 @@ def stage_from_env(env: Optional[dict] = None) -> Optional[StageInfo]:
         return None
     n = int(env["KFT_NUM_STAGES"])
     sid = int(env.get("KFT_STAGE_ID", "0"))
+    workers = int(env.get("KFT_STAGE_WORKERS", "1"))
     return StageInfo(
         stage_id=sid,
         n_stages=n,
         bind=env.get("KFT_STAGE_BIND", "127.0.0.1:0"),
         prev=env.get("KFT_STAGE_PREV") or None,
         next=env.get("KFT_STAGE_NEXT") or None,
-        stage_workers=int(env.get("KFT_STAGE_WORKERS", "1")),
+        stage_workers=workers,
         stage_proc_id=int(env.get("KFT_STAGE_PROC_ID", "0")),
+        virtual_stages=int(env.get("KFT_VIRTUAL_STAGES", "1")),
+        wrap_next=env.get("KFT_STAGE_WRAP_NEXT") or None,
+        wrap_prev=env.get("KFT_STAGE_WRAP_PREV") or None,
+        group_size=int(env.get("KFT_STAGE_GROUP_SIZE", str(workers))),
+        group_rank=int(env.get("KFT_STAGE_GROUP_RANK",
+                               env.get("KFT_STAGE_PROC_ID", "0"))),
+        group_coord=env.get("KFT_STAGE_GROUP_COORD") or None,
     )
 
 

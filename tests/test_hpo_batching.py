@@ -1,0 +1,124 @@
+"""Suggestion-service batching (ROADMAP 4c) — ISSUE 19 satellite.
+
+At swarm scale the controller must amortize its suggestion-service
+round-trips: ONE batched draw per reconcile pass, surplus buffered
+in-process. The buffer is deliberately NOT persisted — resume
+fast-forwards the algorithm by the LAUNCHED prefix only, so a restart
+re-derives the buffered tail deterministically for history-independent
+algorithms (grid/random/sobol)."""
+
+import time
+
+from kubeflow_tpu.hpo.controller import CallableTrialRunner, ExperimentController
+from kubeflow_tpu.hpo.persistence import ExperimentStore
+from kubeflow_tpu.hpo.service import SuggestionCore
+from kubeflow_tpu.hpo.types import (
+    AlgorithmSpec, Experiment, ObjectiveSpec, ParameterSpec, ParameterType,
+    TrialState,
+)
+from kubeflow_tpu.metadata.store import MetadataStore
+
+
+def _grid_exp(name, n=6, parallel=2):
+    return Experiment(
+        name=name,
+        parameters=[ParameterSpec(name="x", type=ParameterType.DOUBLE,
+                                  min=0.0, max=1.0)],
+        algorithm=AlgorithmSpec(name="grid",
+                                settings={"points_per_dim": n}),
+        objective=ObjectiveSpec(metric_name="loss"),
+        max_trial_count=n, parallel_trial_count=parallel,
+        max_failed_trial_count=3,
+    )
+
+
+def _obj(params, report):
+    return (params["x"] - 0.3) ** 2
+
+
+def test_batched_sweep_makes_exactly_one_service_call():
+    exp = _grid_exp("batch1", n=6, parallel=6)
+    core = SuggestionCore()
+    runner = CallableTrialRunner(_obj, max_workers=6)
+    ctl = ExperimentController(exp, runner, core=core, suggestion_batch=6)
+    ctl.run(timeout=60.0)
+    runner.shutdown()
+    assert exp.succeeded
+    # the amortization proof: whole sweep == one GetSuggestions call
+    assert core.counters() == {"calls_total": 1, "served_total": 6}
+    assert ctl.suggestion_calls == 1
+    assert ctl.max_calls_per_pass == 1
+
+
+def test_unbatched_default_draws_per_pass():
+    # suggestion_batch=0 keeps the old per-budget draws (right for
+    # history-dependent algorithms like TPE/CMA-ES)
+    exp = _grid_exp("unbatch", n=3, parallel=1)
+    core = SuggestionCore()
+    runner = CallableTrialRunner(_obj, max_workers=1)
+    ctl = ExperimentController(exp, runner, core=core)
+    ctl.run(timeout=60.0)
+    runner.shutdown()
+    assert exp.succeeded
+    assert core.counters()["calls_total"] >= 3
+    assert ctl.max_calls_per_pass == 1
+
+
+def test_batched_draw_caps_calls_per_pass_under_parallelism():
+    # parallel < batch: surplus is buffered, later passes launch from
+    # the buffer without touching the service again
+    exp = _grid_exp("buf", n=6, parallel=2)
+    core = SuggestionCore()
+    runner = CallableTrialRunner(_obj, max_workers=2)
+    ctl = ExperimentController(exp, runner, core=core, suggestion_batch=6)
+    ctl.run(timeout=60.0)
+    runner.shutdown()
+    assert exp.succeeded
+    assert core.counters()["calls_total"] == 1
+    assert ctl.max_calls_per_pass == 1
+    xs = [round(float(t.parameters["x"]), 6) for t in exp.trials]
+    assert len(xs) == 6 and len(set(xs)) == 6
+
+
+def test_batched_resume_replays_only_launched_prefix(tmp_path):
+    """Crash mid-sweep with suggestions still buffered: the restarted
+    controller must re-derive the UNLAUNCHED tail from a fresh cursor —
+    final parameter sequence identical to an uninterrupted sweep."""
+    # uninterrupted reference sweep
+    ref = _grid_exp("ref")
+    runner0 = CallableTrialRunner(_obj, max_workers=2)
+    ExperimentController(ref, runner0, suggestion_batch=6).run(timeout=60.0)
+    runner0.shutdown()
+    ref_xs = sorted(round(float(t.parameters["x"]), 6) for t in ref.trials)
+
+    wal = str(tmp_path / "md.wal")
+    store = ExperimentStore(MetadataStore(wal_path=wal))
+    exp = _grid_exp("crashy")
+    runner = CallableTrialRunner(_obj, max_workers=2)
+    ctl = ExperimentController(exp, runner, store=store, suggestion_batch=6)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        ctl.step()
+        if sum(t.is_finished() for t in exp.trials) >= 2:
+            break
+        time.sleep(0.01)
+    runner.shutdown()
+    assert not exp.succeeded
+    # the crash drops the in-memory buffer on the floor (never persisted)
+    runner2 = CallableTrialRunner(_obj, max_workers=2)
+    store2 = ExperimentStore(MetadataStore(wal_path=wal))
+    ctl2 = ExperimentController.resume("default", "crashy", runner2, store2,
+                                       suggestion_batch=6)
+    out = ctl2.run(timeout=60.0)
+    runner2.shutdown()
+    assert out.succeeded
+    # trials RUNNING at the crash are KILLED with their points consumed
+    # (pre-existing resume semantics); the batching claim is about the
+    # LAUNCHED sequence: every grid point launched exactly once across
+    # crash + resume, buffered-but-unlaunched points re-derived, none
+    # duplicated, none skipped
+    xs = sorted(round(float(t.parameters["x"]), 6) for t in out.trials)
+    assert xs == ref_xs, "restart must not skip or duplicate grid points"
+    killed = [t for t in out.trials if t.state == TrialState.KILLED]
+    done = [t for t in out.trials if t.state == TrialState.SUCCEEDED]
+    assert len(killed) + len(done) == len(out.trials)
