@@ -9,9 +9,9 @@ PY ?= python
 ASAN_FLAGS = -O1 -g -std=c++17 -Wall -Wextra -pthread \
              -fsanitize=address,undefined -fno-omit-frame-pointer
 
-.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg test-swarm native native-asan test-native-asan dryrun scale-proof clean
+.PHONY: ci test test-kube kube-bench test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-pipeline-elastic test-quant test-disagg test-swarm native native-asan test-native-asan dryrun scale-proof clean
 
-ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-quant test-disagg test-swarm dryrun
+ci: test-native-asan test test-kube test-warmpool test-compile-depot test-serving-sched test-spec-decode test-fleet test-elastic test-obs test-pipeline test-pipeline-elastic test-quant test-disagg test-swarm dryrun
 	@echo "CI OK"
 
 # ONE kube-backend latency bench run (cold / warm-claim / warm-resubmit,
@@ -275,6 +275,43 @@ test-pipeline:
 			+ ' v5p128_proj=' + str(s['v5p128_bubble_projected']) \
 			+ ' overlap=' + str(s['dcn_overlap_fraction']) \
 			+ ' oracle_drift=' + str(lp['oracle_max_rel_diff']))"
+
+# elastic MPMD pipeline e2e (ISSUE 20): the elastic suites (snapshot
+# store prune/common-step, epoch fencing at TCP ingress, rollback-and-
+# replay bitwise parity, mailbox poison with cause, close() frees the
+# stage port for in-process rebind, double-failure and budget-exhaustion
+# reconciler model tests, counter exposition lint) plus the wrap-link
+# poison regressions, then the chaos bench smoke. Two independent teeth
+# (like test-pipeline): bench.py exits nonzero unless a stage worker
+# SIGKILLed mid-run was REPLACED (not gang-restarted) via the warm pool
+# with depot hits, survivors reformed in process at the bumped epoch,
+# the post-recovery loss trajectory is bitwise-equal to an unkilled
+# control leg, the replayed-microbatch count equals its accounting
+# bound, and the stale-frame fence counted at least one dropped frame;
+# the JSON contract is then re-checked from the captured file so a
+# silently vanished recovery field regresses visibly.
+PIPELINE_ELASTIC_SMOKE_JSON := /tmp/kft-pipeline-elastic-smoke.json
+test-pipeline-elastic:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mpmd_elastic.py -x -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mpmd_interleaved.py \
+		-x -q -k "wrap_next_peer or wrap_prev_peer"
+	JAX_PLATFORMS=cpu $(PY) bench.py --pipeline-chaos-smoke > $(PIPELINE_ELASTIC_SMOKE_JSON)
+	$(PY) -c "import json; \
+		d = json.loads(open('$(PIPELINE_ELASTIC_SMOKE_JSON)').read().strip().splitlines()[-1]); \
+		e = d['extra']; r = e['replacement']; p = e['parity']; rec = e['pipeline.recovery']; \
+		assert r['worker_replacements'] >= 1 and r['gang_restarts'] == 0, r; \
+		assert r['zygote_fallbacks_during_recovery'] == 0, ('cold fork', r); \
+		assert r['depot_outcome'] == 'hit', ('replacement depot miss', r); \
+		assert p['full_length'] is True and p['bitwise_equal'] is True, ('replay not bitwise', p); \
+		assert rec['replayed_microbatches'] == rec['replay_bound'], rec; \
+		assert rec['stale_frames_fenced'] > 0 and rec['rendezvous_epoch'] >= 1, rec; \
+		ph = rec['phases']; \
+		assert all(k in ph for k in ('detect', 'claim', 're_rendezvous', 'restore', 'compile', 'replay_window', 'first_tick_after')), ph; \
+		print('pipeline elastic bench OK: recovery=' + str(round(rec['recovery_seconds'], 3)) + 's' \
+			+ ' restored_step=' + str(rec['restored_step']) \
+			+ ' replayed_mb=' + str(rec['replayed_microbatches']) \
+			+ ' fenced=' + str(rec['stale_frames_fenced']) \
+			+ ' epoch=' + str(rec['rendezvous_epoch']))"
 
 # quantized serving e2e (ISSUE 16): the quant suites (quantized-kernel
 # vs quantized-gather-oracle exactness incl. sharded tensor=2, write-path

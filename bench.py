@@ -135,6 +135,11 @@ def main():
     # bubble + DCN overlap; the measured overlap then replaces the
     # roofline's assumed collective-overlap constant below
     pipeline = _pipeline_bench()
+    # elastic MPMD pipeline (ISSUE 20): SIGKILL a stage mid-window,
+    # warm per-worker replacement + in-process survivor reform at the
+    # bumped epoch + rollback-and-replay from the last common boundary,
+    # bitwise loss parity vs an unkilled control leg
+    pipeline_chaos = _pipeline_chaos_bench()
     # disaggregated prefill/decode serving (ISSUE 17): two-tier fleet,
     # live cross-pod paged-KV migration, per-tier depot hits, radix
     # bypass — the CPU kube rig, same as the fleet/recovery benches
@@ -199,6 +204,9 @@ def main():
             # bubble fraction + DCN/compute overlap, loss-identical to
             # the SPMD pipeline_apply oracle
             "pipeline": pipeline,
+            # elastic pipeline recovery: kill→replace→reform→replay
+            # decomposition + epoch-fence counters + bitwise parity
+            "pipeline.recovery": pipeline_chaos,
             # disaggregated serving: co-located vs 1-prefill+1-decode
             # p95s under high load, migration decomposition, tier-scoped
             # depot outcomes, radix-bypass counters
@@ -2993,6 +3001,17 @@ _PIPE_LLAMA_ENV = {"KFT_MPMD_MODEL": "llama", "KFT_MPMD_SEQ": "64",
                    "KFT_MPMD_VOCAB": "256", "KFT_MPMD_HEADS": "4",
                    "KFT_MPMD_KV_HEADS": "2", "KFT_MPMD_MLP": "512"}
 _PIPE_M_LLAMA = 8      # matched microbatch count across the llama legs
+# elastic chaos rig (ISSUE 20): 3 stages so the MIDDLE survivor keeps
+# receiving from its live upstream while blocked on the dead downstream
+# — the structural source of fenced stale frames; the LAST stage is the
+# victim (global rank 2, so the coordinator-died refusal never fires)
+# and owns the loss stream, making its replacement's replayed
+# trajectory the artifact under test. dcn_delay paces a step to a few
+# hundred ms so the kill reliably lands MID-window with frames in
+# flight; steps=10 leaves room for the replay stamps after a kill at
+# boundary ~2-3.
+_PIPE_CHAOS = dict(stages=3, batch=64, dim=128, layers=2, steps=10)
+_PIPE_CHAOS_M = 8
 
 
 def _mpmd_leg(op, ctl, cluster, name: str, env_base: dict, schedule: str,
@@ -3411,6 +3430,339 @@ def pipeline_smoke_main():
           # per-chunk depot hits + per-chunk trace lanes
           and per_chunk_hits
           and trace.get("has_chunk_lanes") is True)
+    return 0 if ok else 1
+
+
+def _pipeline_chaos_bench() -> dict:
+    """ISSUE-20 acceptance: elastic MPMD pipeline — SIGKILL a stage
+    worker MID-RUN and measure the warm per-worker replacement with
+    state handoff and microbatch-window replay.
+
+    Two legs of the SAME llama pipeline (3 stages, 1F1B, M=8), both
+    with boundary snapshots on:
+    - ``control``: unkilled — the reference loss trajectory.
+    - ``chaos``: the last stage is killed mid-window after boundary 2.
+      The reconciler must REPLACE it (zygote warm claim, stage Service
+      address preserved, NOT a gang restart); survivors reform in
+      process at the bumped epoch; the gang rolls back to the last
+      common boundary and replays; the final trajectory must be
+      bitwise-equal to control's.
+
+    ``pipeline.recovery`` decomposes recovery_seconds
+    (detect / claim / re-rendezvous / restore / compile / replay-window
+    / first-tick-after) from the chaos stamp + reconciler log + the
+    replacement's phase stamps, and carries the replay accounting
+    (replayed microbatches == (window - restored) * M) plus the elastic
+    transport counters (stale frames fenced, mailbox poisons,
+    reforms)."""
+    import os
+    import re
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.api.types import RestartPolicy, pipeline_jax_job
+    from kubeflow_tpu.controller import (
+        FaultInjector, JobController, LocalProcessCluster, Operator,
+    )
+
+    S = _PIPE_CHAOS["stages"]
+    M = _PIPE_CHAOS_M
+    tmp = tempfile.mkdtemp(prefix="kft-bench-pipe-chaos-")
+    cluster = LocalProcessCluster(log_dir=os.path.join(tmp, "pods"),
+                                  warm_pool=True)
+    ctl = JobController(cluster)
+    op = Operator(ctl, heartbeat_dir=os.path.join(tmp, "hb"),
+                  reconcile_period=0.1, heartbeat_period=0.2)
+    op.start(port=0)
+    chaos = FaultInjector(cluster)
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env_base = {
+            "PYTHONPATH": repo + ":" + os.environ.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "KFT_FORCE_PLATFORM": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "KFT_COMPILE_CACHE": os.path.join(tmp, "xla-cache"),
+            **_PIPE_LLAMA_ENV,
+            "KFT_MPMD_BATCH": str(_PIPE_CHAOS["batch"]),
+            "KFT_MPMD_DIM": str(_PIPE_CHAOS["dim"]),
+            "KFT_MPMD_LAYERS": str(_PIPE_CHAOS["layers"]),
+            "KFT_MPMD_STEPS": str(_PIPE_CHAOS["steps"]),
+            "KFT_MPMD_SCHEDULE": "1f1b",
+            "KFT_MPMD_MICROBATCHES": str(M),
+            "KFT_MPMD_DCN_DELAY_MS": "20",
+            # the ISSUE-20 env surface: configurable recv timeout (kept
+            # well above the recovery time — the poison path, not the
+            # timeout path, is what unwinds survivors)
+            "KFT_PIPE_RECV_TIMEOUT_S": "75",
+        }
+        out: dict = {"topology": dict(_PIPE_CHAOS), "microbatches": M,
+                     "backend": "LocalProcessCluster/cpu + zygote warm "
+                                "pool (one process per stage, TCP "
+                                "transport, shared snapshot dir)"}
+
+        def submit_leg(name: str, elastic_dir: str) -> str:
+            report = os.path.join(tmp, name)
+            os.makedirs(report, exist_ok=True)
+            os.makedirs(elastic_dir, exist_ok=True)
+            env = {**env_base, "KFT_MPMD_REPORT_DIR": report,
+                   "KFT_ELASTIC_DIR": elastic_dir}
+            job = pipeline_jax_job(
+                name, stages=S,
+                command=[sys.executable, "-m",
+                         "kubeflow_tpu.parallel.mpmd"],
+                env=env)
+            # SIGKILL (exit < 0) must read as retryable so the elastic
+            # path engages instead of failing the job outright
+            job.replica_specs["Worker"].restart_policy = \
+                RestartPolicy.EXIT_CODE
+            op.submit(job)
+            return report
+
+        def wait_finished(name: str, timeout_s: float = 300.0):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                job = ctl.get("default", name)
+                if job is not None and job.status.is_finished():
+                    return job
+                time.sleep(0.2)
+            return ctl.get("default", name)
+
+        def read_reports(report: str, timeout_s: float = 15.0):
+            deadline = time.time() + timeout_s
+            paths = [os.path.join(report, f"stage-{s}.json")
+                     for s in range(S)]
+            while time.time() < deadline:
+                if all(os.path.exists(p) for p in paths):
+                    break
+                time.sleep(0.1)
+            reports = []
+            for p in paths:
+                with open(p) as f:
+                    reports.append(json.load(f))
+            return reports
+
+        def leg_error(name: str, job) -> dict:
+            logs = "\n".join(
+                cluster.pod_log("default", p.name)[-1500:]
+                for p in cluster.list_pods("default",
+                                           {"job-name": name}) or []
+                if p is not None)
+            return {"error": f"job {name} did not succeed",
+                    "condition": str(job and job.status.condition()),
+                    "logs": logs[-5000:]}
+
+        # ---- control leg: identical code path (snapshots on), no kill
+        ctrl_report = submit_leg("pipe-ctrl",
+                                 os.path.join(tmp, "elastic-ctrl"))
+        job = wait_finished("pipe-ctrl")
+        if job is None or not job.status.is_finished() \
+                or job.status.condition().value != "Succeeded":
+            return {**out, **leg_error("pipe-ctrl", job)}
+        control_losses = read_reports(ctrl_report)[-1]["losses"]
+
+        # ---- chaos leg -----------------------------------------------
+        edir = os.path.join(tmp, "elastic-chaos")
+        chaos_report = submit_leg("pipe-chaos", edir)
+        snap_re = re.compile(r"stage(\d+)-step(\d+)-")
+
+        def latests() -> list:
+            best = [-1] * S
+            try:
+                names = os.listdir(edir)
+            except OSError:
+                return best
+            for fn in names:
+                m = snap_re.match(fn)
+                if m and int(m.group(1)) < S:
+                    sid = int(m.group(1))
+                    best[sid] = max(best[sid], int(m.group(2)))
+            return best
+
+        # kill trigger: every stage has a published boundary >= 2, then
+        # ~a third of a step later — mid-window, frames in flight
+        deadline = time.time() + 240
+        while time.time() < deadline and min(latests()) < 2:
+            time.sleep(0.02)
+        if min(latests()) < 2:
+            return {**out, "error": "chaos leg never reached a common "
+                                    "boundary >= 2 within 240s"}
+        time.sleep(0.15)
+        boundaries_at_kill = latests()
+        fallbacks_before = cluster.zygote_fallbacks
+        t_kill = time.time()
+        victim = chaos.kill_stage("default", "pipe-chaos", S - 1)
+        if victim is None:
+            return {**out, "error": "chaos found no live stage "
+                                    f"{S - 1} pod to kill"}
+        job = wait_finished("pipe-chaos")
+        if job is None or not job.status.is_finished() \
+                or job.status.condition().value != "Succeeded":
+            return {**out, **leg_error("pipe-chaos", job)}
+        reports = read_reports(chaos_report)
+        chaos_losses = reports[-1]["losses"]
+
+        # ---- replacement evidence ------------------------------------
+        events = op.job_recovery("default", "pipe-chaos")
+        t_detect = next((e["t"] for e in events
+                         if e["event"] == "worker_failed"
+                         and e["t"] >= t_kill), None)
+        replaced = [e for e in events if e["event"] == "replacement"]
+        gang_restarts = [e for e in events
+                         if e["event"] == "gang_restart"]
+        reforms_signaled = [e for e in events
+                            if e["event"] == "survivor_reform_signaled"]
+        repl_phases = None
+        for _pod, ph in op.job_phases("default", "pipe-chaos").items():
+            if "restore_done" in ph and "first_new_step_done" in ph:
+                repl_phases = ph
+        out["replacement"] = {
+            "victim": victim,
+            "boundaries_at_kill": boundaries_at_kill,
+            "worker_replacements": job.status.worker_replacements,
+            "gang_restarts": len(gang_restarts),
+            "survivor_reforms_signaled": len(reforms_signaled),
+            "zygote_fallbacks_during_recovery": (
+                cluster.zygote_fallbacks - fallbacks_before),
+            "replacement_depot": reports[-1].get("depot"),
+            "depot_outcome": ("hit" if all(
+                r.get("depot", {}).get("hit") for r in reports)
+                else "miss"),
+            "recovery_events": [
+                {k: (round(v, 3) if isinstance(v, float) else v)
+                 for k, v in e.items()} for e in events],
+        }
+        out["parity"] = {
+            "steps_compared": min(len(control_losses),
+                                  len(chaos_losses)),
+            "full_length": (len(control_losses)
+                            == len(chaos_losses)
+                            == _PIPE_CHAOS["steps"]),
+            "bitwise_equal": (bool(control_losses)
+                              and control_losses == chaos_losses),
+            "control_losses": control_losses,
+            "chaos_losses": chaos_losses,
+        }
+        # ---- recovery decomposition + replay accounting --------------
+        per_stage_elastic = {str(r["stage"]): r.get("elastic")
+                             for r in reports}
+        repl_el = reports[-1].get("elastic") or {}
+        restored = repl_el.get("restored_step")
+        window = repl_el.get("replay_window")
+        replayed = repl_el.get("replayed_microbatches")
+        rec: dict = {
+            "restored_step": restored,
+            "replay_window": window,
+            "replayed_microbatches": replayed,
+            "replay_bound": ((window - restored) * M
+                             if window is not None
+                             and restored is not None else None),
+            "rendezvous_epoch": repl_el.get("epoch"),
+            "stale_frames_fenced": sum(
+                (e or {}).get("stale_frames_fenced", 0)
+                for e in per_stage_elastic.values()),
+            "mailbox_poisons": sum(
+                (e or {}).get("mailbox_poisons", 0)
+                for e in per_stage_elastic.values()),
+            "recv_timeouts": sum(
+                (e or {}).get("recv_timeouts", 0)
+                for e in per_stage_elastic.values()),
+            "survivor_reforms": sum(
+                (e or {}).get("reforms", 0)
+                for e in per_stage_elastic.values()),
+            "per_stage_elastic": per_stage_elastic,
+        }
+        if t_detect is not None and repl_phases is not None:
+            rec["recovery_seconds"] = round(
+                repl_phases["first_new_step_done"] - t_kill, 3)
+            rec["phases"] = {
+                "detect": round(t_detect - t_kill, 3),
+                "claim": round(
+                    repl_phases["proc_start"] - t_detect, 3),
+                "re_rendezvous": round(
+                    repl_phases["rendezvous_done"]
+                    - repl_phases["proc_start"], 3),
+                "restore": round(
+                    repl_phases["restore_done"]
+                    - repl_phases["rendezvous_done"], 3),
+                "compile": round(
+                    repl_phases["compile_done"]
+                    - repl_phases["restore_done"], 3),
+                "replay_window": round(
+                    repl_phases["replay_done"]
+                    - repl_phases["compile_done"], 3),
+                "first_tick_after": round(
+                    repl_phases["first_new_step_done"]
+                    - repl_phases["replay_done"], 3),
+            }
+        else:
+            rec["error"] = "incomplete recovery timeline"
+        out["pipeline.recovery"] = rec
+        return out
+    except Exception as e:                     # never sink the bench line
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        for name in ("pipe-ctrl", "pipe-chaos"):
+            try:
+                ctl.delete("default", name)
+            except KeyError:
+                pass
+        op.stop()
+        cluster.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def pipeline_chaos_smoke_main():
+    """``bench.py --pipeline-chaos-smoke``: ONLY the elastic-pipeline
+    chaos scenario (CPU, CI-runnable, ~2-3 min) as one JSON line — the
+    `make test-pipeline-elastic` acceptance entry point. Exits nonzero
+    unless a stage worker SIGKILLed mid-run was REPLACED (not
+    gang-restarted) via the warm path with the replacement depot-hitting
+    its per-stage executables, the run completed, the post-recovery
+    loss trajectory is bitwise-equal to the unkilled control leg, the
+    pipeline.recovery decomposition landed, the replayed-microbatch
+    count equals its (window - restored) * M accounting bound, and the
+    stale-frame epoch fence counted at least one fenced frame."""
+    out = _pipeline_chaos_bench()
+    rec = out.get("pipeline.recovery") or {}
+    repl = out.get("replacement") or {}
+    parity = out.get("parity") or {}
+    print(json.dumps({
+        "metric": "pipeline_chaos_recovery_seconds",
+        "value": rec.get("recovery_seconds"),
+        "unit": "s",
+        "extra": out,
+    }))
+    phases = rec.get("phases") or {}
+    ok = ("error" not in out and "error" not in rec
+          # replaced, not gang-restarted, and warm all the way
+          and repl.get("worker_replacements", 0) >= 1
+          and repl.get("gang_restarts", 1) == 0
+          and repl.get("survivor_reforms_signaled", 0) >= 1
+          and repl.get("zygote_fallbacks_during_recovery", 1) == 0
+          # the replacement (and every stage) deserialized, not compiled
+          and repl.get("depot_outcome") == "hit"
+          # run completed with the control leg's exact trajectory
+          and parity.get("full_length") is True
+          and parity.get("bitwise_equal") is True
+          # rollback-and-replay accounting: a real boundary was
+          # restored and the replayed window matches its bound exactly
+          and rec.get("restored_step") is not None
+          and rec["restored_step"] >= 0
+          and rec.get("replay_window") is not None
+          and 1 <= rec["replay_window"] - rec["restored_step"] <= 2
+          and rec.get("replayed_microbatches") == rec.get("replay_bound")
+          # epoch fencing really fired: frames from the dead window were
+          # dropped+counted, survivors were poisoned into reform at the
+          # bumped epoch
+          and rec.get("stale_frames_fenced", 0) > 0
+          and rec.get("mailbox_poisons", 0) >= 1
+          and rec.get("survivor_reforms", 0) >= _PIPE_CHAOS["stages"] - 1
+          and (rec.get("rendezvous_epoch") or 0) >= 1
+          # the full decomposition landed
+          and all(k in phases for k in
+                  ("detect", "claim", "re_rendezvous", "restore",
+                   "compile", "replay_window", "first_tick_after")))
     return 0 if ok else 1
 
 
@@ -3913,6 +4265,16 @@ if __name__ == "__main__":
                          "depot_outcome=hit, zero gang restarts, the "
                          "phase decomposition, and exact loss-curve "
                          "continuity)")
+    ap.add_argument("--pipeline-chaos-smoke", action="store_true",
+                    help="only the elastic MPMD pipeline chaos scenario "
+                         "(CI smoke; nonzero exit unless a stage worker "
+                         "SIGKILLed mid-run was REPLACED via a warm "
+                         "claim with per-stage depot hits, survivors "
+                         "reformed in process at the bumped epoch with "
+                         "stale frames fenced, the gang replayed the "
+                         "microbatch window from the last common "
+                         "boundary, and the final loss trajectory is "
+                         "bitwise-equal to an unkilled control leg)")
     ap.add_argument("--swarm-smoke", action="store_true",
                     help="only the trial-swarm scenario on the kube rig "
                          "(CI smoke; nonzero exit unless trials claimed "
@@ -3933,6 +4295,8 @@ if __name__ == "__main__":
         sys.exit(quant_smoke_main())
     if cli.pipeline_smoke:
         sys.exit(pipeline_smoke_main())
+    if cli.pipeline_chaos_smoke:
+        sys.exit(pipeline_chaos_smoke_main())
     if cli.disagg_smoke:
         sys.exit(disagg_smoke_main())
     if cli.recovery_smoke:

@@ -157,6 +157,17 @@ class FaultInjector:
                 return pod.name
         return None
 
+    def kill_stage(self, namespace: str, job: str,
+                   stage: int) -> Optional[str]:
+        """Kill the live pod serving one MPMD pipeline STAGE of ``job``
+        (targeted chaos for the elastic-pipeline bench: aim at a specific
+        stage deterministically instead of whoever kill_random draws).
+        Selects by the reconciler-stamped ``pipeline-stage`` pod label and
+        goes through the same lock-fenced ``max_kills`` budget as every
+        other kill. Returns the victim pod name or None."""
+        return self.kill_random(namespace, {
+            "job-name": job, "pipeline-stage": str(stage)})
+
     def wait_for_kill(self, n: int = 1, timeout_s: float = 30.0) -> bool:
         """Block until at least ``n`` kills landed (bench/test barrier)."""
         deadline = time.time() + timeout_s

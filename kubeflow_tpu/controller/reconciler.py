@@ -720,7 +720,10 @@ class JobController:
         survivors = [p for p in pods if p is not None
                      and p.phase == PodPhase.RUNNING]
         restart = getattr(self.cluster, "restart_pod_process", None)
-        if survivors:
+        if survivors and _pipeline_stages(job) <= 1:
+            # pipeline survivors reform in process (no restart needed —
+            # see the commit block), so only the SPMD path requires the
+            # backend to support in-place process restarts
             if restart is None:
                 self._log_recovery(job, "replacement_refused",
                                    reason="no_in_place_restart")
@@ -734,25 +737,39 @@ class JobController:
         # ---- commit ----
         job.status.rendezvous_epoch += 1
         epoch = job.status.rendezvous_epoch
-        # survivors re-rendezvous in place under the new epoch FIRST —
-        # their pods (claims, node-local caches) are NOT deleted. A
-        # signal that fails to deliver leaves that survivor wedged in
-        # the old world, so the whole attempt falls back to the counted
-        # gang restart (which tears every member down uniformly); the
-        # epoch bump stands — the gang path bumps past it again.
-        for p in survivors:
-            try:
-                ok = restart(p.namespace, p.name,
-                             {"KFT_RENDEZVOUS_EPOCH": str(epoch)})
-            except Exception:
-                ok = False
-            self._log_recovery(job, "survivor_restarted", pod=p.name,
-                               ok=bool(ok))
-            if not ok:
-                self._log_recovery(job, "replacement_refused",
-                                   reason="survivor_restart_failed",
-                                   pod=p.name)
-                return False
+        if _pipeline_stages(job) > 1:
+            # MPMD pipeline stages reform IN PROCESS (parallel/mpmd.py
+            # elastic contract): the replacement pod boots with the
+            # bumped epoch and announces it through the shared snapshot
+            # dir; survivors' epoch watchers poison the in-flight
+            # microbatch window, restore the last common step boundary,
+            # and re-rendezvous on the same stage-Service addresses —
+            # keeping their compiled programs and params hot instead of
+            # paying a process restart + recompile per survivor.
+            for p in survivors:
+                self._log_recovery(job, "survivor_reform_signaled",
+                                   pod=p.name, epoch=epoch)
+        else:
+            # survivors re-rendezvous in place under the new epoch FIRST
+            # — their pods (claims, node-local caches) are NOT deleted.
+            # A signal that fails to deliver leaves that survivor wedged
+            # in the old world, so the whole attempt falls back to the
+            # counted gang restart (which tears every member down
+            # uniformly); the epoch bump stands — the gang path bumps
+            # past it again.
+            for p in survivors:
+                try:
+                    ok = restart(p.namespace, p.name,
+                                 {"KFT_RENDEZVOUS_EPOCH": str(epoch)})
+                except Exception:
+                    ok = False
+                self._log_recovery(job, "survivor_restarted", pod=p.name,
+                                   ok=bool(ok))
+                if not ok:
+                    self._log_recovery(job, "replacement_refused",
+                                       reason="survivor_restart_failed",
+                                       pod=p.name)
+                    return False
         attempt = 0
         for p in failed:
             ident = idents[p.name]

@@ -86,6 +86,16 @@ HELP: dict[str, str] = {
         "Bytes of paged-KV payload sent over the migration transport",
     "kft_disagg_wire_seconds_total":
         "Cumulative socket round-trip time of kv frames",
+    # elastic MPMD pipeline (parallel/mpmd.py ElasticStats)
+    "kft_pipeline_recv_timeouts_total":
+        "Stage recv_act/recv_grad waits that hit the recv timeout "
+        "(KFT_PIPE_RECV_TIMEOUT_S) — a wedged or dead neighbor",
+    "kft_pipeline_mailbox_poisons_total":
+        "Microbatch windows aborted through the mailbox-poison path "
+        "(sender-thread transport failures + epoch-bump signals)",
+    "kft_pipeline_stale_frames_fenced_total":
+        "Channel frames from a dead rendezvous incarnation dropped by "
+        "the epoch fence (ingress mismatch + reform-time mailbox drain)",
 }
 
 

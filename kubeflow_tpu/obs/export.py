@@ -278,6 +278,21 @@ def build_job_trace(namespace: str, name: str, uid: str,
             ("recovery.first_step_after", ph["compile_done"],
              ph["first_step_done"]),
         ]
+        # elastic-pipeline replacements stamp three more phases: the
+        # boundary-snapshot load (rendezvous_done -> restore_done, carved
+        # out of load.acquire), the replayed microbatch window (end of
+        # compile -> the previously in-flight step's boundary), and the
+        # first genuinely NEW step after replay — the bench's
+        # pipeline.recovery decomposition reads these spans back
+        if "restore_done" in ph:
+            rec.append(("recovery.restore", ph["rendezvous_done"],
+                        ph["restore_done"]))
+        if "replay_done" in ph:
+            rec.append(("recovery.replay_window", ph["compile_done"],
+                        ph["replay_done"]))
+            if "first_new_step_done" in ph:
+                rec.append(("recovery.first_tick_after", ph["replay_done"],
+                            ph["first_new_step_done"]))
         for rname, t0, t1 in rec:
             if t1 < t0:
                 continue

@@ -164,6 +164,25 @@ def fingerprint(hlo_text: str, mesh=None, platform: str = "",
     return h.hexdigest()
 
 
+def snapshot_fingerprint(items: dict, extra: tuple = ()) -> str:
+    """Content-address an elastic STATE-snapshot lineage (the ISSUE-20
+    step-boundary snapshots in ``parallel/mpmd.StageSnapshotStore``) —
+    the same sha256 idiom as ``fingerprint`` but over run-identity items
+    (config fields, model-spec dims) instead of lowered HLO. Two runs
+    with equal keys produce interchangeable snapshots; anything that
+    changes param SHAPES or the deterministic data stream must be in
+    ``items``. Toolchain versions are deliberately NOT folded in:
+    snapshots are host-staged numpy trees, restorable across jax
+    upgrades — unlike serialized executables."""
+    h = hashlib.sha256()
+    h.update(b"kft-state-snapshot-v1")
+    h.update(json.dumps({str(k): str(v) for k, v in items.items()},
+                        sort_keys=True).encode())
+    for x in extra:
+        h.update(str(x).encode())
+    return h.hexdigest()
+
+
 # -------------------------------------------------------- entry format --
 
 def pack_entry(key: str, payload, error: str = "") -> bytes:

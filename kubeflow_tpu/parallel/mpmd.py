@@ -65,6 +65,31 @@ The model behind the schedule is pluggable (``MLPSpec`` — the
 CI harness — or ``pipeline_llama.MpmdLlamaSpec``: real transformer
 blocks, embedding on chunk 0, LM head on the last chunk), selected by
 ``KFT_MPMD_MODEL`` in the worker entry.
+
+Elastic pipeline (the ISSUE-20 contract): a stage death MID-RUN is a
+bounded, measured event instead of a lost run. Three mechanisms:
+
+- **Boundary snapshots**: every stage publishes a host-staged state
+  snapshot (params + head params + opt slots, ``jax.device_get``-staged
+  like the transport) into ``KFT_ELASTIC_DIR`` at each step boundary,
+  latest TWO retained. Stages can only be one boundary apart (stage 0's
+  step-k update needs grads that need the last stage's step-k backward),
+  so the newest COMMON boundary across all stages is always on disk.
+- **Epoch fencing**: every channel frame carries the rendezvous epoch
+  as the LAST key element. The ingress loop drops (and counts) frames
+  whose epoch differs from the channel's — a late frame from a dead
+  incarnation can never be delivered to ``recv_act``/``recv_grad``.
+- **Rollback + replay**: when the reconciler replaces a dead stage
+  worker (same stage-Service address — neighbors never re-stamp), the
+  replacement announces the bumped epoch through the snapshot dir;
+  survivors abort the in-flight microbatch window via the existing
+  mailbox-poison path (params untouched — they only change at
+  ``apply_grads``), drain-and-count stale frames, re-rendezvous at the
+  new epoch on the SAME binds, every stage restores the newest common
+  boundary, and the schedule replays from there. The loss trajectory is
+  bitwise-identical to an unkilled run from that boundary: batches
+  derive from the absolute step index and grad reduction order is
+  fixed, so replayed steps recompute the exact same updates.
 """
 
 from __future__ import annotations
@@ -341,6 +366,68 @@ class TransportStats:
             }
 
 
+class ElasticStats:
+    """Process-level elastic-recovery counters (thread-safe). Lives OUTSIDE
+    the channel because a reform tears the channel down and rebuilds it at
+    the new epoch — the counters must survive the swap. Exported per stage
+    in ``StageResult.elastic`` and rendered as the
+    ``kft_pipeline_*_total`` exposition families (see
+    ``elastic_exposition_families``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.recv_timeouts = 0
+        self.mailbox_poisons = 0
+        self.stale_frames_fenced = 0
+        self.reforms = 0
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "recv_timeouts": self.recv_timeouts,
+                "mailbox_poisons": self.mailbox_poisons,
+                "stale_frames_fenced": self.stale_frames_fenced,
+                "reforms": self.reforms,
+            }
+
+
+# exposition family name per ElasticStats field (HELP text in obs/expo)
+ELASTIC_FAMILIES = {
+    "recv_timeouts": "kft_pipeline_recv_timeouts_total",
+    "mailbox_poisons": "kft_pipeline_mailbox_poisons_total",
+    "stale_frames_fenced": "kft_pipeline_stale_frames_fenced_total",
+}
+
+
+def elastic_exposition_families(per_stage: dict) -> list:
+    """``{stage_label: elastic_snapshot_dict}`` -> ``render_exposition``
+    families (one counter family per ElasticStats field, one labelled
+    sample per stage) — the shape the operator/bench feed through
+    ``obs.expo.render_exposition`` and ``validate_exposition`` lints."""
+    from kubeflow_tpu.obs.expo import format_labels
+
+    fams = []
+    for field, fam in sorted(ELASTIC_FAMILIES.items()):
+        samples = [(format_labels(stage=s), (snap or {}).get(field, 0))
+                   for s, snap in sorted(per_stage.items())]
+        fams.append((fam, "counter", samples))
+    return fams
+
+
+class EpochBump(RuntimeError):
+    """Poison cause injected by the epoch watcher: a NEW rendezvous epoch
+    was announced (a replacement stage worker booted), so the in-flight
+    microbatch window must be aborted and the channel reformed."""
+
+    def __init__(self, epoch: int):
+        super().__init__(f"rendezvous epoch advanced to {epoch}")
+        self.epoch = epoch
+
+
 class _Mailbox:
     """Keyed rendezvous for incoming frames: readers block per key.
 
@@ -364,6 +451,20 @@ class _Mailbox:
             if self._poison is None:
                 self._poison = exc
             self._lock.notify_all()
+
+    def poison_cause(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._poison
+
+    def drain(self) -> list:
+        """Pop every parked frame key (reform path: the act/grad frames
+        still boxed when the window aborts belong to the dead epoch's
+        window and must be counted as fenced, never replayed into the
+        new incarnation)."""
+        with self._lock:
+            keys = list(self._box)
+            self._box.clear()
+            return keys
 
     def take(self, key: tuple, timeout_s: float):
         deadline = time.monotonic() + timeout_s
@@ -401,7 +502,8 @@ class TCPStageChannel:
                  stage: int, blocking: bool = True, delay_s: float = 0.0,
                  collector=None, timeout_s: float = 120.0,
                  wrap_next: Optional[str] = None,
-                 wrap_prev: Optional[str] = None):
+                 wrap_prev: Optional[str] = None, epoch: int = 0,
+                 elastic: Optional[ElasticStats] = None):
         self.stage = stage
         self.prev_addr = prev
         self.next_addr = next
@@ -414,12 +516,24 @@ class TCPStageChannel:
         self.delay_s = delay_s
         self.timeout_s = timeout_s
         self.collector = collector
+        # rendezvous incarnation this channel speaks: stamped into every
+        # frame key; mismatched ingress frames are fenced, not delivered
+        self.epoch = epoch
+        self.elastic = elastic if elastic is not None else ElasticStats()
         self.stats = TransportStats()
         self.mailbox = _Mailbox()
         self._conns: dict[str, socket.socket] = {}
         self._conn_lock = threading.Lock()
+        self._send_locks: dict[str, threading.Lock] = {}
         self._senders: dict[str, queue.Queue] = {}
         self._sender_threads: list[threading.Thread] = []
+        self._barrier_done = threading.Event()
+        # accepted inbound conns: close() must kill these too — on an
+        # in-process reform the OLD channel object lingers, and a peer's
+        # cached outbound socket into it would otherwise keep accepting
+        # writes into a dead read loop (silent frame loss instead of the
+        # OSError that triggers the peer's evict-and-redial)
+        self._accepted: list[socket.socket] = []
         self._closed = threading.Event()
         host, _, port = bind.rpartition(":")
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -439,8 +553,10 @@ class TCPStageChannel:
                         f":{self._srv.getsockname()[1]}"
                         if bound_host == "0.0.0.0"
                         else f"{bound_host}:{self._srv.getsockname()[1]}")
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name=f"mpmd-accept-{stage}").start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"mpmd-accept-{stage}")
+        self._accept_thread.start()
 
     # --------------------------------------------------------- wire in --
 
@@ -450,6 +566,8 @@ class TCPStageChannel:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            with self._conn_lock:
+                self._accepted.append(conn)
             threading.Thread(target=self._read_loop, args=(conn,),
                              daemon=True,
                              name=f"mpmd-read-{self.stage}").start()
@@ -466,6 +584,31 @@ class TCPStageChannel:
                     return
                 key, payload = pickle.loads(body)
                 self.stats.add(bytes_recv=8 + n, recvs=1)
+                # epoch fence: a frame from another incarnation (pre-epoch
+                # senders carry no 5th element -> epoch 0) is dropped AND
+                # counted here at ingress — it can never satisfy a
+                # recv_act/recv_grad take
+                frame_epoch = key[4] if len(key) > 4 else 0
+                if frame_epoch != self.epoch:
+                    self.elastic.inc("stale_frames_fenced")
+                    continue
+                if len(key) < 5:
+                    # pre-epoch sender: normalise to the 5-field key so the
+                    # frame can satisfy an epoch-aware take at epoch 0
+                    key = (*key, 0)
+                if key[0] == "ready" and self._barrier_done.is_set():
+                    # a downstream peer reforming late resends its ready
+                    # until our go arrives; the original go may have died
+                    # with its previous conn — answer every late ready so
+                    # the barrier handshake can't wedge one-shot
+                    try:
+                        if self.next_addr:
+                            self._wire_send(
+                                self.next_addr,
+                                ("go", -1, -1, -1, self.epoch), b"")
+                    except Exception:
+                        pass
+                    continue
                 self.mailbox.put(key, payload)
         except (OSError, pickle.UnpicklingError, EOFError):
             return
@@ -504,10 +647,19 @@ class TCPStageChannel:
             self._conns.setdefault(peer, s)
             return self._conns[peer]
 
+    def _peer_lock(self, peer: str) -> threading.Lock:
+        with self._conn_lock:
+            return self._send_locks.setdefault(peer, threading.Lock())
+
     def _wire_send(self, peer: str, key: tuple, payload) -> None:
         """The actual wire movement — serialize, emulated DCN latency,
         socket write. Runs on the compute thread (blocking) or a sender
-        thread (async); ``wire_s`` counts it either way."""
+        thread (async); ``wire_s`` counts it either way. A per-peer lock
+        serializes writers (barrier resends and the read loop's go
+        replies can race the sender thread); a send failure evicts the
+        cached conn and redials ONCE — the elastic contract keeps stage
+        addresses stable across replacement, so a peer that reformed is
+        reachable again at the same address with a fresh listener."""
         t0 = time.perf_counter()
         span = None
         if self.collector is not None:
@@ -519,7 +671,18 @@ class TCPStageChannel:
         data = _encode(key, payload)
         if self.delay_s:
             time.sleep(self.delay_s)
-        self._connect(peer).sendall(data)
+        with self._peer_lock(peer):
+            try:
+                self._connect(peer).sendall(data)
+            except OSError:
+                with self._conn_lock:
+                    s = self._conns.pop(peer, None)
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                self._connect(peer).sendall(data)
         dt = time.perf_counter() - t0
         self.stats.add(wire_s=dt, bytes_sent=len(data), sends=1)
         if span is not None:
@@ -538,6 +701,7 @@ class TCPStageChannel:
                 # surface the transport failure to the compute thread NOW
                 # (its next recv raises with this cause) instead of dying
                 # silently and leaving it to a 2-minute recv timeout
+                self.elastic.inc("mailbox_poisons")
                 self.mailbox.poison(e)
                 return
 
@@ -560,11 +724,12 @@ class TCPStageChannel:
         self.stats.add(send_block_s=time.perf_counter() - t0)  # ~enqueue
 
     # ------------------------------------------------------------- api --
-    # Frames key by (kind, step, mb, virtual_stage) so the same
-    # microbatch crossing the same worker V times (interleaved) never
-    # aliases; vstage defaults to 0 so plain callers are unchanged.
-    # ``wrap=True`` routes over the ring-closure link instead of the
-    # line neighbor (see __init__).
+    # Frames key by (kind, step, mb, virtual_stage, epoch): vstage so the
+    # same microbatch crossing the same worker V times (interleaved)
+    # never aliases; epoch LAST so the ingress fence can reject frames
+    # from a dead incarnation while every older key position (step/mb
+    # span attrs, vstage routing) keeps its index. ``wrap=True`` routes
+    # over the ring-closure link instead of the line neighbor.
 
     def send_act(self, step: int, mb: int, payload, vstage: int = 0, *,
                  wrap: bool = False) -> None:
@@ -573,7 +738,7 @@ class TCPStageChannel:
             raise RuntimeError(
                 f"stage {self.stage}: no {'wrap_next' if wrap else 'next'} "
                 "peer for send_act")
-        self._send(peer, ("act", step, mb, vstage), payload)
+        self._send(peer, ("act", step, mb, vstage, self.epoch), payload)
 
     def send_grad(self, step: int, mb: int, payload, vstage: int = 0, *,
                   wrap: bool = False) -> None:
@@ -582,18 +747,21 @@ class TCPStageChannel:
             raise RuntimeError(
                 f"stage {self.stage}: no {'wrap_prev' if wrap else 'prev'} "
                 "peer for send_grad")
-        self._send(peer, ("grad", step, mb, vstage), payload)
+        self._send(peer, ("grad", step, mb, vstage, self.epoch), payload)
 
     def recv_act(self, step: int, mb: int, vstage: int = 0):
-        return self._recv(("act", step, mb, vstage))
+        return self._recv(("act", step, mb, vstage, self.epoch))
 
     def recv_grad(self, step: int, mb: int, vstage: int = 0):
-        return self._recv(("grad", step, mb, vstage))
+        return self._recv(("grad", step, mb, vstage, self.epoch))
 
     def _recv(self, key: tuple):
         t0 = time.perf_counter()
         try:
             return self.mailbox.take(key, self.timeout_s)
+        except TimeoutError:
+            self.elastic.inc("recv_timeouts")
+            raise
         finally:
             self.stats.add(recv_block_s=time.perf_counter() - t0)
 
@@ -602,32 +770,98 @@ class TCPStageChannel:
         'go' propagates stage 0 -> last. Every stage returns only once
         the WHOLE pipeline is compiled and listening, so step-0 sends
         never queue into a neighbor's compile window and the measured
-        windows start aligned."""
+        windows start aligned.
+
+        Reform-tolerant: stages re-rendezvous at a new epoch at slightly
+        different times, so a ready sent upstream can land on the peer's
+        DYING previous channel (fenced there, lost). The sender therefore
+        RESENDS its ready every poll interval until the go comes back;
+        the receiver answers late duplicate readys from the read loop
+        (see ``_read_loop``). Duplicate frames are idempotent — the
+        mailbox keys them identically."""
+        deadline = time.monotonic() + self.timeout_s
+        poll = min(0.5, self.timeout_s)
+
+        def take_with(resend, key):
+            while True:
+                if resend is not None:
+                    self._wire_send(resend, ("ready", -1, -1, -1,
+                                             self.epoch), b"")
+                try:
+                    return self.mailbox.take(key, poll)
+                except TimeoutError:
+                    if time.monotonic() >= deadline:
+                        raise
+
         if self.next_addr:
-            self.mailbox.take(("ready", -1, -1), self.timeout_s)
+            take_with(None, ("ready", -1, -1, -1, self.epoch))
         if self.prev_addr:
-            self._wire_send(self.prev_addr, ("ready", -1, -1), b"")
-            self.mailbox.take(("go", -1, -1), self.timeout_s)
+            take_with(self.prev_addr, ("go", -1, -1, -1, self.epoch))
         if self.next_addr:
-            self._wire_send(self.next_addr, ("go", -1, -1), b"")
+            self._wire_send(self.next_addr, ("go", -1, -1, -1, self.epoch),
+                            b"")
+        self._barrier_done.set()
+
+    def drain_stale(self) -> int:
+        """Reform path: count-and-drop the act/grad frames still parked
+        in the mailbox when the microbatch window aborts — they belong to
+        the dead incarnation's window and must never be consumed by the
+        replayed schedule (replay re-receives everything at the new
+        epoch). Returns the number fenced."""
+        n = sum(1 for k in self.mailbox.drain() if k and k[0] in
+                ("act", "grad"))
+        if n:
+            self.elastic.inc("stale_frames_fenced", n)
+        return n
 
     def close(self) -> None:
         self._closed.set()
         for q in self._senders.values():
             q.put(None)
+        # shutdown() BEFORE close(), on every socket: close() alone never
+        # wakes a thread pinned inside accept()/recv()/sendall() on the
+        # same socket — the kernel holds the socket open until the
+        # syscall returns. For the listener that means THE PORT STAYS
+        # BOUND after close() (the in-process reform's rebind of the
+        # stage-Service port would fail EADDRINUSE forever); for the
+        # accepted conns it means peers' cached outbound sockets keep
+        # sendall-ing into a dead read loop instead of getting the FIN/
+        # RST that triggers their evict-and-redial.
+        with self._conn_lock:
+            socks = list(self._conns.values()) + list(self._accepted)
+            self._conns.clear()
+            self._accepted.clear()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         for t in self._sender_threads:
             t.join(timeout=5.0)
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass       # listeners reject shutdown on some kernels
+        # belt and braces: a throwaway connect unblocks a pinned accept()
+        # even where shutdown() on a listening socket is a no-op
+        try:
+            with socket.create_connection(
+                    ("127.0.0.1",
+                     int(self.address.rpartition(":")[2])),
+                    timeout=0.5):
+                pass
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5.0)
         try:
             self._srv.close()
         except OSError:
             pass
-        with self._conn_lock:
-            for s in self._conns.values():
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._conns.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class InProcFabric:
@@ -640,21 +874,30 @@ class InProcFabric:
 
     def channel(self, stage: int, *, blocking: bool = True,
                 delay_s: float = 0.0, collector=None,
-                timeout_s: float = 60.0) -> "InProcChannel":
+                timeout_s: float = 60.0, epoch: int = 0,
+                elastic: Optional[ElasticStats] = None) -> "InProcChannel":
         return InProcChannel(self, stage, blocking=blocking,
                              delay_s=delay_s, collector=collector,
-                             timeout_s=timeout_s)
+                             timeout_s=timeout_s, epoch=epoch,
+                             elastic=elastic)
 
 
 class InProcChannel:
     def __init__(self, fabric: InProcFabric, stage: int, *, blocking: bool,
-                 delay_s: float, collector, timeout_s: float):
+                 delay_s: float, collector, timeout_s: float,
+                 epoch: int = 0,
+                 elastic: Optional[ElasticStats] = None):
         self.fabric = fabric
         self.stage = stage
         self.blocking = blocking
         self.delay_s = delay_s
         self.collector = collector
         self.timeout_s = timeout_s
+        # same epoch-last key element as the TCP channel: a stale frame
+        # can never match a take key, so the in-proc fabric fences by
+        # key mismatch (no wire ingress loop to count at)
+        self.epoch = epoch
+        self.elastic = elastic if elastic is not None else ElasticStats()
         self.stats = TransportStats()
         self._q: Optional[queue.Queue] = None
         self._sender: Optional[threading.Thread] = None
@@ -704,23 +947,26 @@ class InProcChannel:
     def send_act(self, step, mb, payload, vstage: int = 0, *,
                  wrap: bool = False):
         dest = 0 if wrap else self.stage + 1
-        self._send(dest, ("act", step, mb, vstage), payload)
+        self._send(dest, ("act", step, mb, vstage, self.epoch), payload)
 
     def send_grad(self, step, mb, payload, vstage: int = 0, *,
                   wrap: bool = False):
         dest = len(self.fabric.mailboxes) - 1 if wrap else self.stage - 1
-        self._send(dest, ("grad", step, mb, vstage), payload)
+        self._send(dest, ("grad", step, mb, vstage, self.epoch), payload)
 
     def recv_act(self, step, mb, vstage: int = 0):
-        return self._recv(("act", step, mb, vstage))
+        return self._recv(("act", step, mb, vstage, self.epoch))
 
     def recv_grad(self, step, mb, vstage: int = 0):
-        return self._recv(("grad", step, mb, vstage))
+        return self._recv(("grad", step, mb, vstage, self.epoch))
 
     def _recv(self, key):
         t0 = time.perf_counter()
         try:
             return self.fabric.mailboxes[self.stage].take(key, self.timeout_s)
+        except TimeoutError:
+            self.elastic.inc("recv_timeouts")
+            raise
         finally:
             self.stats.add(recv_block_s=time.perf_counter() - t0)
 
@@ -731,6 +977,123 @@ class InProcChannel:
         if self._q is not None:
             self._q.put(None)
             self._sender.join(timeout=5.0)
+
+
+# ------------------------------------------------------ state snapshots --
+
+class StageSnapshotStore:
+    """Per-stage step-boundary state snapshots + the epoch announce file,
+    on a directory every stage worker shares (``KFT_ELASTIC_DIR``).
+
+    One ``.snap`` file per (stage, step), atomic tmp+rename publish,
+    latest TWO retained per stage: neighbors' newest boundaries differ by
+    at most ONE step (stage 0's step-k update needs grads that need the
+    last stage's step-k backward), so retaining two guarantees the newest
+    COMMON boundary — ``common_step()`` = min over stages' latest — is on
+    disk for every stage even when its own latest is one ahead.
+    Snapshots are keyed by a run fingerprint (``run_fingerprint``: config
+    + model spec identity) so a llama run can never restore an MLP run's
+    bytes.
+
+    ``announce_epoch``/``epoch`` give the dir a second role: the
+    rendezvous-epoch bulletin. A replacement worker boots with the bumped
+    ``KFT_RENDEZVOUS_EPOCH`` and announces it here; survivors' epoch
+    watchers poll it and poison their in-flight window — the signal path
+    that replaces PR 9's survivor process restarts for pipeline jobs
+    (an in-process reform keeps compiled programs and params hot)."""
+
+    KEEP = 2
+
+    def __init__(self, root: str, *, fingerprint: str = ""):
+        self.root = root
+        self.fp = (fingerprint or "")[:16]
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, stage: int, step: int) -> str:
+        tag = f"-{self.fp}" if self.fp else ""
+        return os.path.join(self.root,
+                            f"stage{stage}-step{step:06d}{tag}.snap")
+
+    def _list(self, stage: int) -> list:
+        """Sorted [(step, path)] for one stage (this fingerprint only)."""
+        prefix, out = f"stage{stage}-step", []
+        suffix = (f"-{self.fp}.snap" if self.fp else ".snap")
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for fn in names:
+            if not (fn.startswith(prefix) and fn.endswith(suffix)):
+                continue
+            digits = fn[len(prefix):len(prefix) + 6]
+            if digits.isdigit():
+                out.append((int(digits), os.path.join(self.root, fn)))
+        return sorted(out)
+
+    def publish(self, stage: int, step: int, payload: dict) -> str:
+        path = self._path(stage, step)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        os.replace(tmp, path)
+        for _, old in self._list(stage)[:-self.KEEP]:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        return path
+
+    def load(self, stage: int, step: int) -> dict:
+        with open(self._path(stage, step), "rb") as f:
+            return pickle.load(f)
+
+    def latest_steps(self, n_stages: int) -> list:
+        """Per-stage newest published boundary (-1 = none yet)."""
+        return [(self._list(s)[-1][0] if self._list(s) else -1)
+                for s in range(n_stages)]
+
+    def common_step(self, n_stages: int) -> int:
+        """Newest boundary EVERY stage has published — the restore point
+        of the rollback protocol (-1: no completed common boundary, the
+        run restarts from initial state)."""
+        return min(self.latest_steps(n_stages))
+
+    # ------------------------------------------- epoch announce file --
+
+    def announce_epoch(self, epoch: int) -> None:
+        """Monotonic: never lowers the announced epoch (a slow survivor
+        re-announcing its old epoch must not roll back a replacement's
+        bump)."""
+        if epoch <= self.epoch():
+            return
+        path = os.path.join(self.root, "epoch.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": int(epoch)}, f)
+        os.replace(tmp, path)
+
+    def epoch(self) -> int:
+        try:
+            with open(os.path.join(self.root, "epoch.json")) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError):
+            return 0
+
+
+def run_fingerprint(cfg: "PipelineRunConfig", spec=None) -> str:
+    """Snapshot lineage key: the run config + the model spec's identity
+    (name + whatever dims ``snapshot_meta`` declares). Two runs with the
+    same fingerprint produce interchangeable snapshots; anything that
+    changes param shapes or the data stream changes the key."""
+    from kubeflow_tpu.parallel.depot import snapshot_fingerprint
+
+    items = dict(dataclasses.asdict(cfg))
+    items["model"] = getattr(spec, "name", "mlp") if spec is not None \
+        else "mlp"
+    meta = getattr(spec, "snapshot_meta", None)
+    if callable(meta):
+        items.update(meta(cfg))
+    return snapshot_fingerprint(items)
 
 
 # -------------------------------------------------------- model spec --
@@ -792,6 +1155,13 @@ class MLPSpec:
         x, t = step_batch(cfg, step)
         return (np.asarray(x).reshape(M, R, cfg.dim),
                 np.asarray(t).reshape(M, R, 1))
+
+    def snapshot_meta(self, cfg: PipelineRunConfig) -> dict:
+        """Spec-identity items folded into the snapshot fingerprint (see
+        ``run_fingerprint``) beyond the run config — anything that
+        changes this spec's param shapes."""
+        return {"spec": self.name, "dim": cfg.dim,
+                "layers": cfg.layers_per_stage}
 
 
 # -------------------------------------------------------- stage runtime --
@@ -993,6 +1363,41 @@ class StageRuntime:
                 "hit": all(v == "hit" for v in self.depot_outcomes.values()),
                 "counters": self.depot_stats.snapshot()}
 
+    # ------------------------------------------------- elastic state --
+
+    def export_state(self) -> dict:
+        """Host-staged (``jax.device_get``) copy of everything
+        ``apply_grads`` mutates — the step-boundary snapshot payload.
+        ``opt_state`` is None today (the update rule is stateless SGD);
+        the key exists so snapshots grow slots without a format break
+        when a stateful optimizer lands. RNG needs no slot: every random
+        stream (params, batches) derives from (seed, absolute index)."""
+        import jax
+
+        return {
+            "params": [jax.device_get(p) for p in self.params],
+            "head_params": (jax.device_get(self.head_params)
+                            if self.head_params is not None else None),
+            "opt_state": None,
+            "seed": self.cfg.seed,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Inverse of ``export_state``: device_put the host-staged leaves
+        back onto this stage's mesh placements. Bitwise: device_get /
+        device_put round-trip float32 buffers exactly, so a restored
+        boundary replays the identical trajectory."""
+        import jax
+
+        if self.mesh is not None:
+            put = lambda t: jax.device_put(t, self._rep)  # noqa: E731
+        else:
+            put = jax.device_put
+        self.params = [put(p) for p in state["params"]]
+        if self.is_last and state.get("head_params") is not None:
+            self.head_params = put(state["head_params"])
+        jax.block_until_ready(self.params)
+
 
 # ------------------------------------------------------------ run loop --
 
@@ -1005,11 +1410,18 @@ class StageResult:
     depot: dict
     schedule: str
     max_stash: int
+    # elastic-recovery accounting (ElasticStats.snapshot() + restore/
+    # replay bookkeeping added by the worker entry); None on plain runs
+    elastic: Optional[dict] = None
 
 
 def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
               runtime: Optional[StageRuntime] = None, collector=None,
               on_step: Optional[Callable[[int, Optional[float]], None]] = None,
+              start_step: int = 0, prior_losses: Optional[list] = None,
+              prior_step_stats: Optional[list] = None,
+              snapshots: Optional[StageSnapshotStore] = None,
+              on_sync: Optional[Callable[[int, Optional[int]], None]] = None,
               ) -> StageResult:
     """Execute ``cfg.steps`` pipeline training steps for ONE stage.
 
@@ -1027,7 +1439,15 @@ def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
     (device_put/get), and the blocking part of sends — is work; bubble
     is the remaining (schedule-induced) idleness. An exposed transfer
     still raises the measured bubble, just where it physically bites:
-    as the DOWNSTREAM stage's wait (and in send_block/overlap stats)."""
+    as the DOWNSTREAM stage's wait (and in send_block/overlap stats).
+
+    Elastic hooks: ``start_step``/``prior_losses``/``prior_step_stats``
+    resume the schedule from a restored boundary (batches derive from
+    the ABSOLUTE step index, so a replayed step recomputes the exact
+    bytes of its first run); ``snapshots`` publishes the boundary state
+    after every ``apply_grads``. On an abort mid-window, params are
+    untouched (they only ever change at the boundary) — the caller
+    restores a snapshot and re-enters with the next start_step."""
     import jax  # noqa: F401  (device staging inside runtime)
 
     rt = runtime if runtime is not None else StageRuntime(cfg, stage)
@@ -1038,9 +1458,29 @@ def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
     # normalize 2-field (phase, mb) ticks to (phase, vchunk=0, mb)
     ticks = [t if len(t) == 3 else (t[0], 0, t[1]) for t in raw]
     chan.barrier_ready()
-    step_stats = []
-    losses: list = []
-    for k in range(cfg.steps):
+    if snapshots is not None:
+        # post-barrier restore sync: a survivor can publish ONE more
+        # boundary after the replacement pod already read its boot
+        # restore point (the straggler step whose frames were all in
+        # its mailbox when the neighbor died) — so per-boot reads can
+        # disagree by a step and the gang would replay from different
+        # boundaries. After the barrier every stage is parked, nothing
+        # publishes, and the store is quiescent: re-derive the restore
+        # point HERE so all stages pick the same boundary.
+        latest = snapshots.latest_steps(cfg.n_stages)
+        r = min(latest)
+        snap = (snapshots.load(stage, r)
+                if r > start_step - 1 else None)
+        if snap is not None:
+            rt.restore_state(snap["state"])
+            prior_losses = snap["losses"]
+            prior_step_stats = snap["step_stats"]
+            start_step = r + 1
+        if on_sync is not None:
+            on_sync(r, max(latest) + 1 if r >= 0 else None)
+    step_stats = list(prior_step_stats or [])
+    losses: list = list(prior_losses or [])
+    for k in range(start_step, cfg.steps):
         if rt.is_first:
             x_host, _ = spec.batch(cfg, k)
         if rt.is_last:
@@ -1130,12 +1570,25 @@ def run_stage(cfg: PipelineRunConfig, stage: int, chan, *,
         step_stats.append({"t0": t_step0, "t1": time.perf_counter(),
                            "busy_s": round(busy, 6),
                            "send_block_s": round(block1 - block0, 6)})
+        if snapshots is not None:
+            # boundary snapshot: params just updated, nothing in flight
+            # for step k remains. losses/step_stats ride along so a
+            # restored worker reports the FULL trajectory, not a suffix.
+            snapshots.publish(stage, k, {
+                "stage": stage, "step": k, "schedule": cfg.schedule,
+                "state": rt.export_state(),
+                "losses": list(losses), "step_stats": list(step_stats),
+            })
         if on_step is not None:
             on_step(k, losses[-1] if rt.is_last else None)
+    elastic = (chan.elastic.snapshot()
+               if getattr(chan, "elastic", None) is not None
+               and (snapshots is not None or start_step) else None)
     return StageResult(
         stage=stage, losses=losses, step_stats=step_stats,
         transport=chan.stats.snapshot(), depot=rt.depot_summary(),
-        schedule=cfg.schedule, max_stash=max_live_stash(ticks))
+        schedule=cfg.schedule, max_stash=max_live_stash(ticks),
+        elastic=elastic)
 
 
 # --------------------------------------------------------- measurement --
@@ -1343,12 +1796,95 @@ def _worker_main() -> int:
         return 0
     cfg = PipelineRunConfig.from_env()
     collector = SpanCollector(proc=f"stage{info.stage_id}")
-    chan = TCPStageChannel(
-        info.bind, prev=info.prev, next=info.next, stage=info.stage_id,
-        blocking=cfg.schedule == "gpipe", delay_s=cfg.dcn_delay_ms / 1e3,
-        collector=collector, wrap_next=info.wrap_next,
-        wrap_prev=info.wrap_prev)
+    timeout_s = float(os.environ.get("KFT_PIPE_RECV_TIMEOUT_S", "120"))
+    park_s = float(os.environ.get("KFT_PIPE_PARK_S", "60"))
+    max_reforms = int(os.environ.get("KFT_PIPE_MAX_REFORMS", "4"))
+    estats = ElasticStats()
+
+    spec = None
+    if os.environ.get("KFT_MPMD_MODEL", "mlp") == "llama":
+        from kubeflow_tpu.parallel.pipeline_llama import mpmd_llama_spec
+
+        spec = mpmd_llama_spec(cfg)
+
+    # elastic mode: the shared snapshot dir doubles as the epoch bulletin.
+    # A replacement worker boots with the reconciler's bumped
+    # KFT_RENDEZVOUS_EPOCH and ANNOUNCES it here; survivors are not
+    # restarted — their epoch watcher sees the bump, poisons the
+    # in-flight window, and reforms in process (programs + params hot).
+    store = None
+    epoch = info.epoch
+    if os.environ.get("KFT_ELASTIC_DIR"):
+        store = StageSnapshotStore(
+            os.environ["KFT_ELASTIC_DIR"],
+            fingerprint=run_fingerprint(cfg, spec))
+        epoch = max(epoch, store.epoch())
+        store.announce_epoch(epoch)
+
+    def _start_channel(ep: int) -> TCPStageChannel:
+        return TCPStageChannel(
+            info.bind, prev=info.prev, next=info.next, stage=info.stage_id,
+            blocking=cfg.schedule == "gpipe",
+            delay_s=cfg.dcn_delay_ms / 1e3, collector=collector,
+            timeout_s=timeout_s, wrap_next=info.wrap_next,
+            wrap_prev=info.wrap_prev, epoch=ep, elastic=estats)
+
+    def _watch(chan: TCPStageChannel) -> threading.Event:
+        """Poll the epoch bulletin; on a bump, poison the in-flight
+        window so the compute thread unwinds promptly even when it is
+        blocked in a long recv far from the dead stage."""
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(0.2):
+                e = store.epoch()
+                if e > chan.epoch:
+                    estats.inc("mailbox_poisons")
+                    chan.mailbox.poison(EpochBump(e))
+                    return
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"mpmd-epoch-watch-{info.stage_id}").start()
+        return stop
+
+    def _restore_point():
+        """(common_step, max_step, own snapshot at common_step)."""
+        latest = store.latest_steps(cfg.n_stages)
+        r = min(latest)
+        snap = store.load(info.stage_id, r) if r >= 0 else None
+        return r, max(latest), snap
+
+    def _await_epoch(cur: int, err: BaseException) -> int:
+        deadline = time.monotonic() + park_s
+        while time.monotonic() < deadline:
+            e = store.epoch()
+            if e > cur:
+                return e
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"stage {info.stage_id}: window aborted and no new epoch "
+            f"announced within {park_s}s (gang restart is the fallback)"
+        ) from err
+
+    chan = _start_channel(epoch)
     _phase(phases, "rendezvous_done")
+
+    # boot-time restore decision BEFORE compile: a replacement (or a
+    # gang-restart pod) finds published boundaries and loads its own
+    # stage's bytes at the newest COMMON step — stamped restore_done so
+    # the recovery trace can carve restore out of claim->compile.
+    start_step, prior_losses, prior_stats = 0, [], []
+    restored_step, replay_window = -1, None
+    boot_snap = None
+    if store is not None:
+        r, mx, boot_snap = _restore_point()
+        if boot_snap is not None:
+            restored_step, replay_window = r, mx + 1
+            start_step = r + 1
+            prior_losses = boot_snap["losses"]
+            prior_stats = boot_snap["step_stats"]
+            phases["restored_step"] = float(r)
+            _phase(phases, "restore_done")
 
     dstats = DepotStats()
     try:
@@ -1356,17 +1892,14 @@ def _worker_main() -> int:
     except Exception:
         dstats.inc("fetch_errors")
         depot = None
-    spec = None
-    if os.environ.get("KFT_MPMD_MODEL", "mlp") == "llama":
-        from kubeflow_tpu.parallel.pipeline_llama import mpmd_llama_spec
-
-        spec = mpmd_llama_spec(cfg)
     rt = StageRuntime(cfg, info.stage_id, depot=depot, depot_stats=dstats,
                       spec=spec)
     phases["depot_hit"] = 1.0 if rt.depot_summary()["hit"] else 0.0
     phases["stage_id"] = float(info.stage_id)
     _phase(phases, "compile_done",
            extra={"depot": dstats.snapshot()} if depot is not None else None)
+    if boot_snap is not None:
+        rt.restore_state(boot_snap["state"])
 
     hb_path = os.environ.get("KFT_HEARTBEAT_FILE")
     hb = Heartbeat(hb_path) if hb_path else None
@@ -1374,16 +1907,88 @@ def _worker_main() -> int:
     def on_step(step: int, loss: Optional[float]) -> None:
         if "first_step_done" not in phases:
             _phase(phases, "first_step_done")
+        if replay_window is not None:
+            # recovery decomposition stamps: the end of the replayed
+            # window (the step that was in flight at the kill) and the
+            # first genuinely NEW step after it
+            if step == replay_window and "replay_done" not in phases:
+                _phase(phases, "replay_done")
+            elif (step == replay_window + 1
+                    and "first_new_step_done" not in phases):
+                _phase(phases, "first_new_step_done")
         if hb is not None:
             hb.beat(step)
 
+    def on_sync(r: int, w: Optional[int]) -> None:
+        # run_stage's post-barrier restore sync is authoritative (the
+        # boot read can be a step stale — see run_stage): adopt it so
+        # the replay stamps and the report's accounting match what the
+        # gang actually replays
+        nonlocal restored_step, replay_window
+        if w is not None:
+            restored_step, replay_window = r, w
+
+    attempt = 0
     try:
-        result = run_stage(cfg, info.stage_id, chan, runtime=rt,
-                           collector=collector, on_step=on_step)
+        while True:
+            watcher_stop = _watch(chan) if store is not None else None
+            try:
+                result = run_stage(
+                    cfg, info.stage_id, chan, runtime=rt,
+                    collector=collector, on_step=on_step,
+                    start_step=start_step, prior_losses=prior_losses,
+                    prior_step_stats=prior_stats, snapshots=store,
+                    on_sync=on_sync)
+                break
+            except (RuntimeError, TimeoutError) as err:
+                if store is None or attempt >= max_reforms:
+                    raise
+                attempt += 1
+                # in-process reform: count-and-fence the dead window's
+                # parked frames, drop the old incarnation's channel,
+                # park until the replacement announces the new epoch,
+                # roll back to the newest common boundary, re-listen on
+                # the SAME bind at the new epoch and replay
+                chan.drain_stale()
+                chan.close()
+                epoch = _await_epoch(epoch, err)
+                estats.inc("reforms")
+                r, mx, snap = _restore_point()
+                if snap is not None:
+                    rt.restore_state(snap["state"])
+                    restored_step, replay_window = r, mx + 1
+                    start_step = r + 1
+                    prior_losses = snap["losses"]
+                    prior_stats = snap["step_stats"]
+                else:
+                    # no common boundary yet: params may have advanced
+                    # past step boundaries the gang cannot all reach —
+                    # rebuild the deterministic initial state
+                    rt.restore_state({
+                        "params": [rt.spec.chunk_params(cfg, c)
+                                   for c in rt.chunks],
+                        "head_params": (rt.spec.head_params(cfg)
+                                        if rt.is_last else None)})
+                    start_step, prior_losses, prior_stats = 0, [], []
+                    restored_step, replay_window = -1, None
+                chan = _start_channel(epoch)
+            finally:
+                if watcher_stop is not None:
+                    watcher_stop.set()
     finally:
         chan.close()
         if hb is not None:
             hb.close()
+
+    if store is not None:
+        result.elastic = {
+            **(result.elastic or {}), **estats.snapshot(),
+            "epoch": epoch, "restored_step": restored_step,
+            "replay_window": replay_window,
+            "replayed_microbatches": (
+                (replay_window - restored_step) * cfg.microbatches
+                if replay_window is not None else 0),
+        }
 
     report_dir = os.environ.get("KFT_MPMD_REPORT_DIR")
     if report_dir:

@@ -254,6 +254,17 @@ class MpmdLlamaSpec:
         return (toks[:, :-1].reshape(M, R, self.seq),
                 toks[:, 1:].reshape(M, R, self.seq))
 
+    def snapshot_meta(self, cfg) -> dict:
+        """Spec identity folded into the elastic snapshot fingerprint
+        (mpmd.run_fingerprint): everything that changes the llama param
+        SHAPES or token stream — a llama snapshot must never restore
+        into an MLP run, nor into a llama run with different dims."""
+        m = self.mcfg
+        return {"spec": self.name, "vocab": m.vocab_size, "dim": m.dim,
+                "n_layers": m.n_layers, "heads": m.n_heads,
+                "kv_heads": m.n_kv_heads, "mlp": m.mlp_dim,
+                "seq": self.seq}
+
 
 def mpmd_llama_spec(run_cfg, env=None) -> MpmdLlamaSpec:
     mcfg = mpmd_model_config(run_cfg, env)

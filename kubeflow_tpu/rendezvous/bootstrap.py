@@ -93,6 +93,14 @@ class StageInfo:
     group_size: int = 1
     group_rank: int = 0
     group_coord: Optional[str] = None
+    # elastic pipeline (ISSUE 20): the rendezvous epoch this worker was
+    # launched into (the reconciler bumps job.status.rendezvous_epoch on
+    # every replacement/gang restart and stamps it on NEW pods; a
+    # replacement stage worker announces it through the snapshot dir so
+    # surviving stages reform in process), and the per-pod incarnation
+    # counter distinguishing a replacement from the pod it replaced.
+    epoch: int = 0
+    incarnation: int = 0
 
     @property
     def is_first(self) -> bool:
@@ -128,6 +136,8 @@ def stage_from_env(env: Optional[dict] = None) -> Optional[StageInfo]:
         group_rank=int(env.get("KFT_STAGE_GROUP_RANK",
                                env.get("KFT_STAGE_PROC_ID", "0"))),
         group_coord=env.get("KFT_STAGE_GROUP_COORD") or None,
+        epoch=int(env.get("KFT_RENDEZVOUS_EPOCH", "0") or 0),
+        incarnation=int(env.get("KFT_WORKER_INCARNATION", "0") or 0),
     )
 
 

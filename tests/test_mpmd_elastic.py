@@ -1,0 +1,359 @@
+"""Elastic MPMD pipeline (ISSUE 20): step-boundary stage snapshots,
+epoch-stamped frame fencing, rollback-and-replay, and the reconciler's
+mid-run stage replacement with in-process survivor reform.
+
+The recovery contract under test: when a stage worker dies mid-window,
+the reconciler replaces ONLY that worker (stage-Service-stable address,
+warm claim), survivors fence the dead incarnation's frames by rendezvous
+epoch and reform IN PROCESS (compiled programs + params stay hot), and
+the whole gang rolls back to the newest COMMON step boundary and
+replays — producing a loss trajectory BITWISE identical to a run that
+was never killed (params only change at apply_grads on a boundary;
+batches derive from the absolute step; grad reduce order is fixed)."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel.mpmd import (
+    ELASTIC_FAMILIES, ElasticStats, EpochBump, InProcFabric,
+    PipelineRunConfig, StageRuntime, StageSnapshotStore, TCPStageChannel,
+    _encode, elastic_exposition_families, run_fingerprint, run_stage,
+)
+
+TINY = dict(n_stages=2, microbatches=4, global_batch=32, dim=48,
+            layers_per_stage=2, steps=4)
+
+
+# ----------------------------------------------------- snapshot store --
+
+def test_snapshot_store_publish_prune_and_common_step(tmp_path):
+    store = StageSnapshotStore(str(tmp_path), fingerprint="abc")
+    for k in range(4):
+        store.publish(0, k, {"step": k})
+    # latest-two retention: boundaries 0/1 pruned, 2/3 kept — neighbors
+    # drift by at most one step, so two always covers the common boundary
+    assert store.latest_steps(2) == [3, -1]
+    assert store.load(0, 3)["step"] == 3
+    assert store.load(0, 2)["step"] == 2
+    with pytest.raises(OSError):
+        store.load(0, 1)
+    store.publish(1, 2, {"step": 2})
+    assert store.latest_steps(2) == [3, 2]
+    assert store.common_step(2) == 2
+
+
+def test_snapshot_store_epoch_bulletin_is_monotonic(tmp_path):
+    store = StageSnapshotStore(str(tmp_path))
+    assert store.epoch() == 0
+    store.announce_epoch(2)
+    # a slow survivor re-announcing its stale epoch must not roll back
+    # the replacement's bump
+    store.announce_epoch(1)
+    assert store.epoch() == 2
+    # a second store on the same dir (another stage worker) sees it
+    assert StageSnapshotStore(str(tmp_path)).epoch() == 2
+
+
+def test_snapshot_fingerprint_isolates_lineages(tmp_path):
+    cfg = PipelineRunConfig(schedule="1f1b", **TINY)
+    fp_a = run_fingerprint(cfg)
+    fp_b = run_fingerprint(dataclasses.replace(cfg, dim=cfg.dim * 2))
+    assert fp_a != fp_b
+    a = StageSnapshotStore(str(tmp_path), fingerprint=fp_a)
+    b = StageSnapshotStore(str(tmp_path), fingerprint=fp_b)
+    a.publish(0, 1, {"who": "a"})
+    # same dir, different run identity: b must never see a's boundaries
+    assert b.latest_steps(1) == [-1]
+    assert a.latest_steps(1) == [1]
+
+
+def test_llama_fingerprint_folds_model_dims():
+    from kubeflow_tpu.parallel.pipeline_llama import mpmd_llama_spec
+
+    cfg = PipelineRunConfig(schedule="1f1b", n_stages=2, microbatches=4,
+                            global_batch=8, dim=64, layers_per_stage=2,
+                            steps=2)
+    env = {"KFT_MPMD_SEQ": "16", "KFT_MPMD_VOCAB": "64",
+           "KFT_MPMD_HEADS": "4", "KFT_MPMD_KV_HEADS": "2",
+           "KFT_MPMD_MLP": "128"}
+    base = run_fingerprint(cfg, mpmd_llama_spec(cfg, env))
+    assert base != run_fingerprint(cfg)            # llama != mlp
+    # a llama snapshot must never restore into a differently-shaped
+    # llama run either: vocab changes the head params AND the tokens
+    grown = mpmd_llama_spec(cfg, {**env, "KFT_MPMD_VOCAB": "128"})
+    assert run_fingerprint(cfg, grown) != base
+
+
+# ------------------------------------------------- rollback-and-replay --
+
+def _run_threaded(cfg, store, *, runtimes=None, on_sync=None):
+    """All stages as threads over InProcFabric with snapshots on —
+    run_inproc doesn't thread the elastic params through."""
+    fabric = InProcFabric(cfg.n_stages)
+    results: list = [None] * cfg.n_stages
+    errors: list = []
+
+    def work(s):
+        chan = fabric.channel(s, blocking=cfg.schedule == "gpipe")
+        try:
+            results[s] = run_stage(
+                cfg, s, chan,
+                runtime=runtimes[s] if runtimes else None,
+                snapshots=store, on_sync=on_sync)
+        except Exception as e:
+            errors.append((s, e))
+        finally:
+            chan.close()
+
+    threads = [threading.Thread(target=work, args=(s,), daemon=True)
+               for s in range(cfg.n_stages)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    assert not errors, errors
+    return results
+
+
+def test_rollback_replay_losses_bitwise(tmp_path):
+    """The acceptance bar in miniature: run 2 boundary steps, then a
+    FRESH set of runtimes restores from the shared store via run_stage's
+    post-barrier sync and replays to the end — the full trajectory is
+    bitwise-equal to a run that was never interrupted."""
+    cfg = PipelineRunConfig(schedule="1f1b", **TINY)
+    full = _run_threaded(
+        cfg, StageSnapshotStore(str(tmp_path / "full"),
+                                fingerprint=run_fingerprint(cfg)))
+    full_losses = full[-1].losses
+    assert len(full_losses) == cfg.steps
+
+    store = StageSnapshotStore(str(tmp_path / "cut"),
+                               fingerprint=run_fingerprint(cfg))
+    _run_threaded(dataclasses.replace(cfg, steps=2), store)
+    assert store.common_step(cfg.n_stages) == 1
+
+    # resumed leg: default-initialized runtimes; the post-barrier restore
+    # sync must overwrite them from boundary 1 and replay steps 2..3
+    synced = []
+    resumed = _run_threaded(
+        cfg, store,
+        runtimes=[StageRuntime(cfg, s) for s in range(cfg.n_stages)],
+        on_sync=lambda r, w: synced.append((r, w)))
+    assert resumed[-1].losses == full_losses       # bitwise
+    assert (1, 2) in synced
+    el = resumed[-1].elastic
+    assert el is not None and el["recv_timeouts"] == 0
+
+
+# ----------------------------------------------------- epoch fencing --
+
+def _wait(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_epoch_fence_drops_and_counts_stale_tcp_frames():
+    """A frame from the dead incarnation (older epoch in the key) must
+    be dropped AND counted at ingress — never delivered to the replayed
+    schedule — while same-epoch frames flow normally."""
+    rx = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=1,
+                         epoch=1, timeout_s=0.3)
+    old = TCPStageChannel("127.0.0.1:0", prev=None, next=rx.address,
+                          stage=0, epoch=0)
+    new = TCPStageChannel("127.0.0.1:0", prev=None, next=rx.address,
+                          stage=0, epoch=1)
+    try:
+        old.send_act(0, 0, np.full((2,), 3.0, np.float32))
+        assert _wait(lambda: rx.elastic.snapshot()
+                     ["stale_frames_fenced"] >= 1)
+        with pytest.raises(TimeoutError):     # fenced, not delivered
+            rx.recv_act(0, 0)
+        new.send_act(0, 0, np.full((2,), 9.0, np.float32))
+        assert rx.recv_act(0, 0)[0] == 9.0
+    finally:
+        for ch in (old, new, rx):
+            ch.close()
+
+
+def test_pre_epoch_frames_read_as_epoch_zero():
+    """Wire-compat: a 4-field key from a pre-elastic build is epoch 0 —
+    delivered to an epoch-0 channel, fenced by any newer epoch."""
+    import socket as socketlib
+
+    rx0 = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=1,
+                          epoch=0, timeout_s=3.0)
+    rx1 = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=1,
+                          epoch=1, timeout_s=0.3)
+    try:
+        frame = _encode(("act", 0, 0, 0),
+                        np.full((2,), 5.0, np.float32))
+        for ch in (rx0, rx1):
+            port = int(ch.address.rpartition(":")[2])
+            with socketlib.create_connection(("127.0.0.1", port)) as s:
+                s.sendall(frame)
+        assert rx0.recv_act(0, 0)[0] == 5.0
+        with pytest.raises(TimeoutError):
+            rx1.recv_act(0, 0)
+        assert rx1.elastic.snapshot()["stale_frames_fenced"] == 1
+    finally:
+        rx0.close()
+        rx1.close()
+
+
+def test_drain_stale_counts_only_window_frames():
+    ch = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=0)
+    try:
+        ch.mailbox.put(("act", 3, 1, 0, 0), b"x")
+        ch.mailbox.put(("grad", 3, 0, 0, 0), b"y")
+        ch.mailbox.put(("ready", -1, -1, -1, 0), b"")
+        assert ch.drain_stale() == 2            # barrier frames excluded
+        assert ch.elastic.snapshot()["stale_frames_fenced"] == 2
+        assert ch.drain_stale() == 0            # idempotent once drained
+    finally:
+        ch.close()
+
+
+def test_epoch_bump_poison_reaches_blocked_take_with_cause():
+    ch = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=0,
+                         timeout_s=30.0)
+    try:
+        bump = EpochBump(2)
+        threading.Timer(0.1, ch.mailbox.poison, args=(bump,)).start()
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="stage transport failed") \
+                as ei:
+            ch.recv_act(0, 0)
+        assert time.perf_counter() - t0 < 5.0   # poison, not timeout
+        assert ei.value.__cause__ is bump and bump.epoch == 2
+        assert ch.mailbox.poison_cause() is bump
+    finally:
+        ch.close()
+
+
+def test_channel_close_frees_port_for_inprocess_rebind():
+    """Reform regression: close() must actually release the listen port.
+    A thread parked in accept() pins the listening socket in the kernel
+    past close() unless close() shuts it down and joins the acceptor —
+    the survivor's re-bind of its stage-Service port would otherwise
+    fail EADDRINUSE on every in-process reform, forever."""
+    ch = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=0)
+    bind = ch.address
+    for _ in range(3):                         # several reforms in a row
+        ch.close()
+        ch = TCPStageChannel(bind, prev=None, next=None, stage=0)
+        assert ch.address == bind
+    ch.close()
+
+
+# ------------------------------------------- reconciler: double failure --
+
+def _booted_pipeline_job(ctl, cluster, name="pl", stages=3):
+    from kubeflow_tpu.api.types import pipeline_jax_job
+
+    ctl.restart_backoff_base_s = 0      # no backoff between kills
+    job = ctl.submit(pipeline_jax_job(name, stages=stages))
+    ctl.reconcile("default", name)
+    cluster.run_scheduled()
+    ctl.reconcile("default", name)
+    return job
+
+
+def _fail_and_replace(ctl, cluster, job, pod):
+    from kubeflow_tpu.controller.cluster import PodPhase
+
+    cluster.set_phase("default", pod, PodPhase.FAILED, -9)
+    ctl.reconcile("default", job.name)          # detect + replace
+    cluster.run_scheduled()                     # replacement pod comes up
+    ctl.reconcile("default", job.name)          # recreate pass
+    cluster.run_scheduled()                     # recreated rank → RUNNING
+
+
+def test_double_failure_converges_to_second_replacement():
+    """A second stage death while the gang is still replaying the first
+    window converges to a SECOND per-worker replacement at a SECOND
+    epoch bump — not a gang restart."""
+    from kubeflow_tpu.controller.cluster import FakeCluster
+    from kubeflow_tpu.controller.reconciler import JobController
+
+    cluster = FakeCluster()
+    cluster.warm_pool = True
+    ctl = JobController(cluster)
+    job = _booted_pipeline_job(ctl, cluster)
+
+    _fail_and_replace(ctl, cluster, job, "pl-worker-1")
+    assert job.status.worker_replacements == 1
+    assert job.status.rendezvous_epoch == 1
+    _fail_and_replace(ctl, cluster, job, "pl-worker-2")
+    assert job.status.worker_replacements == 2
+    assert job.status.rendezvous_epoch == 2
+    assert job.status.restart_count == 0        # never gang-restarted
+
+    events = ctl.recovery_log[("default", "pl")]
+    assert [e["event"] for e in events if e["event"] == "replacement"] \
+        == ["replacement", "replacement"]
+    # survivors were signaled (not restarted) at each bump: 2 per event
+    reforms = [e for e in events
+               if e["event"] == "survivor_reform_signaled"]
+    assert len(reforms) == 4
+    assert {e["epoch"] for e in reforms} == {1, 2}
+    pods = {e["pod"] for e in reforms if e["epoch"] == 2}
+    assert pods == {"pl-worker-0", "pl-worker-1"}
+
+
+def test_replacement_budget_exhaustion_counts_gang_restart():
+    """A stage that keeps dying burns ITS replacement budget; past the
+    backoff limit the reconciler refuses and falls back to the COUNTED
+    gang restart — the decision table in the README's elastic section."""
+    from kubeflow_tpu.controller.cluster import FakeCluster
+    from kubeflow_tpu.controller.reconciler import JobController
+
+    cluster = FakeCluster()
+    cluster.warm_pool = True
+    ctl = JobController(cluster)
+    job = _booted_pipeline_job(ctl, cluster)
+    limit = job.run_policy.backoff_limit
+
+    for i in range(limit):
+        _fail_and_replace(ctl, cluster, job, "pl-worker-1")
+        cluster.run_scheduled()
+        ctl.reconcile("default", "pl")
+    assert job.status.worker_replacements == limit
+    assert job.status.restart_count == 0
+
+    _fail_and_replace(ctl, cluster, job, "pl-worker-1")
+    events = ctl.recovery_log[("default", "pl")]
+    refused = [e for e in events if e["event"] == "replacement_refused"]
+    assert refused and refused[-1]["reason"] == "worker_budget_exhausted"
+    assert job.status.restart_count == 1
+    assert any(e["event"] == "gang_restart" for e in events)
+
+
+# ----------------------------------------------------- obs exposition --
+
+def test_elastic_counters_render_and_lint_clean():
+    from kubeflow_tpu.obs.expo import (
+        HELP, render_exposition, validate_exposition,
+    )
+
+    stats = ElasticStats()
+    stats.inc("recv_timeouts")
+    stats.inc("mailbox_poisons", 2)
+    stats.inc("stale_frames_fenced", 5)
+    fams = elastic_exposition_families(
+        {"0": stats.snapshot(), "1": ElasticStats().snapshot()})
+    assert {f[0] for f in fams} == set(ELASTIC_FAMILIES.values())
+    for fam in ELASTIC_FAMILIES.values():
+        assert fam in HELP                      # registered HELP text
+    text = render_exposition(fams)
+    assert validate_exposition(text) == []
+    assert 'kft_pipeline_stale_frames_fenced_total{stage="0"} 5' in text
+    assert 'kft_pipeline_mailbox_poisons_total{stage="0"} 2' in text
+    assert 'kft_pipeline_recv_timeouts_total{stage="1"} 0' in text

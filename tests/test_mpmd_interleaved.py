@@ -409,3 +409,58 @@ def test_llama_schedule_and_partition_parity():
     _, lp = _llama_run(_llama_cfg("1f1b", 2, layers=2, steps=3))
     assert lp[0] == li[0]
     assert max(abs(a - b) / abs(b) for a, b in zip(li, lp)) <= 2e-5
+
+
+# ------------------------------------------------- wrap-link sender poison --
+
+def test_dead_wrap_next_peer_poisons_recv_promptly():
+    """A sender thread hitting a dead RING-WRAP peer (last stage's
+    r+vS -> chunk (v+1)S activation hop back to worker 0) must poison
+    the compute thread's next recv exactly like a straight-link death —
+    the wrap links ride the same async sender machinery, so a regression
+    here would leave an interleaved run wedged in a 120s recv timeout."""
+    import time as _t
+
+    import numpy as _np
+
+    from kubeflow_tpu.parallel.mpmd import TCPStageChannel
+
+    tx = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=1,
+                         blocking=False, timeout_s=30.0,
+                         wrap_next="127.0.0.1:1")     # port 1: refused
+    tx.timeout_s = 0.3
+    try:
+        tx.send_act(0, 0, _np.zeros((2,), _np.float32), vstage=1,
+                    wrap=True)
+        _t.sleep(1.0)          # let the sender exhaust its connect window
+        t0 = _t.perf_counter()
+        with pytest.raises(RuntimeError, match="stage transport failed"):
+            tx.recv_grad(0, 0, vstage=1)
+        assert _t.perf_counter() - t0 < 1.0        # poison, not timeout
+    finally:
+        tx.close()
+
+
+def test_dead_wrap_prev_peer_poisons_recv_promptly():
+    """Same contract for the reverse wrap hop: worker 0 returning
+    grad-activations to the last stage over wrap_prev."""
+    import time as _t
+
+    import numpy as _np
+
+    from kubeflow_tpu.parallel.mpmd import TCPStageChannel
+
+    tx = TCPStageChannel("127.0.0.1:0", prev=None, next=None, stage=0,
+                         blocking=False, timeout_s=30.0,
+                         wrap_prev="127.0.0.1:1")     # port 1: refused
+    tx.timeout_s = 0.3
+    try:
+        tx.send_grad(0, 0, _np.zeros((2,), _np.float32), vstage=0,
+                     wrap=True)
+        _t.sleep(1.0)
+        t0 = _t.perf_counter()
+        with pytest.raises(RuntimeError, match="stage transport failed"):
+            tx.recv_act(0, 0, vstage=0)
+        assert _t.perf_counter() - t0 < 1.0        # poison, not timeout
+    finally:
+        tx.close()
